@@ -1,0 +1,21 @@
+"""TPU-native distributed LLM framework.
+
+A brand-new JAX/XLA/Pallas framework providing the capabilities of AWS
+NeuronX-Distributed (reference: /root/reference, surveyed in SURVEY.md):
+TP/SP/PP/EP/DP parallelism, ZeRO-1 optimizer state sharding, distributed
+checkpointing, MoE/LoRA/quantization module zoo, Pallas flash attention,
+and an AOT-compiled inference stack with KV cache / bucketing / speculative
+decoding — designed GSPMD-first (one mesh + sharding annotations + shard_map
+collectives) rather than as a port of the reference's torch-xla MPMD design.
+"""
+
+__version__ = "0.1.0"
+
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state  # noqa: F401
+from neuronx_distributed_llama3_2_tpu.parallel.state import (  # noqa: F401
+    ParallelConfig,
+    initialize_model_parallel,
+    get_parallel_state,
+    model_parallel_is_initialized,
+    destroy_model_parallel,
+)

@@ -1,0 +1,13 @@
+from neuronx_distributed_llama3_2_tpu.parallel.state import (  # noqa: F401
+    DP_AXIS,
+    EP_AXIS,
+    PP_AXIS,
+    TP_AXIS,
+    ParallelConfig,
+    ParallelState,
+    destroy_model_parallel,
+    get_data_parallel_axes,
+    get_parallel_state,
+    initialize_model_parallel,
+    model_parallel_is_initialized,
+)

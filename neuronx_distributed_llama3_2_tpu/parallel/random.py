@@ -1,0 +1,41 @@
+"""Model-parallel RNG streams.
+
+Replaces the reference's ``XLARNGStatesTracker`` (parallel_layers/random.py:20)
+and ``model_parallel_xla_manual_seed`` (random.py:100). The reference keeps two
+named CUDA-style RNG streams: a default stream (same across TP ranks, for
+dropout on duplicated activations) and a ``model-parallel-rng`` stream
+(seed + 2718 + tp_rank, for dropout/init on TP-sharded activations).
+
+In JAX, RNG is functional: the equivalents are
+
+  - ``data_parallel_key(key)``: identical on all tp ranks (use as-is);
+  - ``tensor_parallel_key(key)``: fold in the tp rank so each shard draws an
+    independent stream — call *inside* shard_map where ``axis_index`` exists.
+
+Deterministic param init for sharded layers instead follows the reference's
+CPU-side "build full master weight, slice per rank" recipe
+(``create_local_weight`` layers.py:58): we init the *global* array with one
+key and let GSPMD shard it, so results are bitwise-independent of tp size.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from neuronx_distributed_llama3_2_tpu.parallel.state import TP_AXIS
+
+_MODEL_PARALLEL_FOLD = 2718  # reference random.py:100 seed offset
+
+
+def tensor_parallel_key(key: jax.Array) -> jax.Array:
+    """Per-tp-rank independent key (reference 'model-parallel-rng' stream,
+    random.py:100-118). Only valid inside shard_map over the tp axis."""
+    return jax.random.fold_in(
+        jax.random.fold_in(key, _MODEL_PARALLEL_FOLD), lax.axis_index(TP_AXIS)
+    )
+
+
+def data_parallel_key(key: jax.Array) -> jax.Array:
+    """Identity: the default (TP-replicated) stream (random.py:100)."""
+    return key

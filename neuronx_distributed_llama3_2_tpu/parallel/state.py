@@ -1,0 +1,231 @@
+"""Parallel state: the device mesh and axis bookkeeping.
+
+TPU-native replacement for the reference's process-group construction
+(``parallel_layers/parallel_state.py``, e.g. ``initialize_model_parallel``
+parallel_state.py:60 and the rank-tensor reshape ``[PP, DP, TP]`` /
+``[PP, DP_exp, EP, TP]`` documented at parallel_state.py:74-184).
+
+Instead of per-rank ``torch.distributed`` process groups, we build a single
+``jax.sharding.Mesh`` whose axis order mirrors the reference's rank layout:
+
+    (pp, dp, ep, tp)   with tp innermost (stride 1)
+
+so that the tensor-parallel axis maps onto physically adjacent devices
+(ICI-adjacent on TPU, the analogue of the reference's "TP contiguous for
+intra-node comms" rule, parallel_state.py:218-244). The reference's
+process-group *getters* (parallel_state.py:447-622) become mesh-axis-size
+getters here; collectives are expressed against named axes instead of group
+handles.
+
+The ``ep`` axis splits the data-parallel dimension exactly like the
+reference's expert-parallel layout (dp = dp_exp * ep, parallel_state.py:86-95):
+  - non-expert parameters are data-parallel over ("dp", "ep") combined;
+  - expert parameters are data-parallel over "dp" only (the "expert DP"
+    group, reference EDP) and expert-sharded over "ep".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+# Canonical mesh axis names, outermost to innermost.
+PP_AXIS = "pp"
+DP_AXIS = "dp"
+EP_AXIS = "ep"
+TP_AXIS = "tp"
+MESH_AXES = (PP_AXIS, DP_AXIS, EP_AXIS, TP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Degrees of parallelism. Replaces the (tp, pp, ep) arguments of the
+    reference's ``initialize_model_parallel`` (parallel_state.py:60) plus the
+    ``sequence_parallel`` flag of ``neuronx_distributed_config``
+    (trainer/trainer.py:33)."""
+
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    # Megatron-style sequence parallelism: activations sharded along the
+    # sequence dim over the *tp* axis between TP blocks (reference §2.10 SP).
+    sequence_parallel: bool = False
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            if f.name.endswith("_size"):
+                v = getattr(self, f.name)
+                if not isinstance(v, int) or v < 1:
+                    raise ValueError(f"{f.name} must be a positive int, got {v!r}")
+
+    @property
+    def model_parallel_size(self) -> int:
+        return self.tensor_parallel_size * self.pipeline_parallel_size
+
+
+@dataclasses.dataclass
+class ParallelState:
+    """Global parallel state: the mesh plus derived sizes."""
+
+    mesh: Mesh
+    config: ParallelConfig
+
+    @property
+    def tensor_parallel_size(self) -> int:
+        return self.mesh.shape[TP_AXIS]
+
+    @property
+    def pipeline_parallel_size(self) -> int:
+        return self.mesh.shape[PP_AXIS]
+
+    @property
+    def expert_parallel_size(self) -> int:
+        return self.mesh.shape[EP_AXIS]
+
+    @property
+    def data_parallel_size(self) -> int:
+        # Reference DP size = dp_exp * ep (parallel_state.py:86-95).
+        return self.mesh.shape[DP_AXIS] * self.mesh.shape[EP_AXIS]
+
+    @property
+    def expert_data_parallel_size(self) -> int:
+        return self.mesh.shape[DP_AXIS]
+
+    @property
+    def sequence_parallel(self) -> bool:
+        return self.config.sequence_parallel
+
+
+_PARALLEL_STATE: Optional[ParallelState] = None
+
+
+def build_mesh(
+    config: ParallelConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the (pp, dp, ep, tp) mesh.
+
+    Replaces the rank-tensor reshape + group construction of
+    ``_build_and_assign_groups`` (parallel_state.py:388). tp is the innermost
+    (fastest-varying) axis so TP collectives ride adjacent ICI links, the
+    analogue of the reference's TP-contiguity rule (parallel_state.py:218-244).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    tp, pp, ep = (
+        config.tensor_parallel_size,
+        config.pipeline_parallel_size,
+        config.expert_parallel_size,
+    )
+    if n % (tp * pp) != 0:
+        raise ValueError(
+            f"world size {n} not divisible by tp*pp = {tp}*{pp}"
+        )
+    dp_total = n // (tp * pp)
+    if dp_total % ep != 0:
+        raise ValueError(
+            f"data parallel size {dp_total} not divisible by expert_parallel_size {ep}"
+        )
+    dp = dp_total // ep
+    dev_array = np.asarray(devices).reshape(pp, dp, ep, tp)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    expert_model_parallel_size: int = 1,
+    sequence_parallel: bool = False,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> ParallelState:
+    """Initialize global parallel state (reference parallel_state.py:60).
+
+    Unlike the reference there is no collective warm-up dummy all-reduce
+    (parallel_state.py:271-280) — XLA initializes collectives at compile time —
+    and no NKI state injection (``try_set_nki_parallel_state``
+    parallel_state.py:425): Pallas kernels receive mesh axes lexically.
+    """
+    global _PARALLEL_STATE
+    config = ParallelConfig(
+        tensor_parallel_size=tensor_model_parallel_size,
+        pipeline_parallel_size=pipeline_model_parallel_size,
+        expert_parallel_size=expert_model_parallel_size,
+        sequence_parallel=sequence_parallel,
+    )
+    mesh = build_mesh(config, devices)
+    _PARALLEL_STATE = ParallelState(mesh=mesh, config=config)
+    logger.info(
+        "initialized parallel state: mesh=%s", dict(mesh.shape)
+    )
+    return _PARALLEL_STATE
+
+
+def model_parallel_is_initialized() -> bool:
+    return _PARALLEL_STATE is not None
+
+
+def get_parallel_state() -> ParallelState:
+    if _PARALLEL_STATE is None:
+        raise RuntimeError(
+            "parallel state not initialized; call initialize_model_parallel()"
+        )
+    return _PARALLEL_STATE
+
+
+def destroy_model_parallel() -> None:
+    """Reference parallel_state.py:625."""
+    global _PARALLEL_STATE
+    _PARALLEL_STATE = None
+
+
+# ---------------------------------------------------------------------------
+# Size/rank getters mirroring the reference API surface
+# (parallel_state.py:447-622). Ranks only exist inside shard_map/jit bodies on
+# TPU (there is one controller program, not one process per device), so the
+# *_rank getters take no global meaning here; use jax.lax.axis_index(axis)
+# inside shard_map instead.
+# ---------------------------------------------------------------------------
+
+def get_tensor_model_parallel_size() -> int:
+    return get_parallel_state().tensor_parallel_size
+
+
+def get_pipeline_model_parallel_size() -> int:
+    return get_parallel_state().pipeline_parallel_size
+
+
+def get_expert_model_parallel_size() -> int:
+    return get_parallel_state().expert_parallel_size
+
+
+def get_data_parallel_size() -> int:
+    return get_parallel_state().data_parallel_size
+
+
+def get_expert_data_parallel_size() -> int:
+    return get_parallel_state().expert_data_parallel_size
+
+
+def get_data_parallel_axes(expert: bool = False) -> Tuple[str, ...]:
+    """Axes over which gradients of a parameter are data-parallel-reduced.
+
+    Non-expert params reduce over ("dp", "ep") — the reference's DP group;
+    expert params reduce over ("dp",) only — the reference's expert-DP (EDP)
+    group (parallel_state.py:86-95; grads.py:273-281 two-phase EP reduce).
+    """
+    return (DP_AXIS,) if expert else (DP_AXIS, EP_AXIS)
+
+
+def rmsg(msg: str) -> str:
+    """Rank-tagged log message (reference parallel_state.py:740). On TPU there
+    is a single controller per host; tag with process index."""
+    return f"[pid{jax.process_index()}] {msg}"

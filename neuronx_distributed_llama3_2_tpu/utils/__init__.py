@@ -1,0 +1,1 @@
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger  # noqa: F401

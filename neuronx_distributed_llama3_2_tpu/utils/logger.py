@@ -1,0 +1,39 @@
+"""Process-0-only logger with env-controlled level.
+
+Replaces the reference's ``utils/logger.py`` (get_logger :16-51, NXD_LOG_LEVEL
+:20,103). On TPU there is one controller process per host rather than one per
+core, so "rank 0 only" becomes "jax process 0 only".
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def get_logger(name: str = "nxdt", rank0_only: bool = True) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if getattr(logger, "_nxdt_rank0_only", None) == rank0_only:
+        return logger
+    # (re)configure — either first call or the rank0_only policy changed
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    level = os.environ.get("NXDT_LOG_LEVEL", "INFO").upper()
+    logger.setLevel(level)
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s [%(levelname)s] %(name)s: %(message)s")
+    )
+    if rank0_only:
+        try:
+            import jax
+
+            if jax.process_index() != 0:
+                handler.setLevel(logging.CRITICAL)
+        except Exception:
+            pass
+    logger.addHandler(handler)
+    logger.propagate = False
+    logger._nxdt_rank0_only = rank0_only  # type: ignore[attr-defined]
+    return logger

@@ -1,0 +1,25 @@
+"""Test config: force an 8-device virtual CPU backend before jax import.
+
+Mirrors the reference's hardware-free unit-test tier (SURVEY.md §4): schedules
+and partition logic test pure; distributed numerics test on a multi-device CPU
+mesh (the analogue of the reference's mocked process groups +
+single-XLA-device golden comparisons, test/unit_test/...).
+"""
+
+import jax
+
+# jax may already be imported by the environment's sitecustomize with a TPU
+# backend registered; config.update (not env vars) is the reliable override.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    yield
+    parallel_state.destroy_model_parallel()

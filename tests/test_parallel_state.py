@@ -1,0 +1,54 @@
+import jax
+import numpy as np
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.parallel import state as ps
+
+
+def test_mesh_shape_tp_pp():
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=4, pipeline_model_parallel_size=2)
+    assert st.tensor_parallel_size == 4
+    assert st.pipeline_parallel_size == 2
+    assert st.data_parallel_size == 1
+    assert dict(st.mesh.shape) == {"pp": 2, "dp": 1, "ep": 1, "tp": 4}
+
+
+def test_mesh_tp_innermost_contiguous():
+    # tp shards must be adjacent devices (reference TP-contiguity rule,
+    # parallel_state.py:218-244).
+    st = ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    devs = np.asarray(st.mesh.devices).reshape(-1)
+    ids = [d.id for d in devs]
+    assert ids == sorted(ids)
+    # first tp group = devices 0,1
+    tp_row = st.mesh.devices[0, 0, 0, :]
+    assert [d.id for d in tp_row] == [0, 1]
+
+
+def test_expert_parallel_splits_dp():
+    st = ps.initialize_model_parallel(
+        tensor_model_parallel_size=2, expert_model_parallel_size=2
+    )
+    assert st.data_parallel_size == 4
+    assert st.expert_data_parallel_size == 2
+    assert st.expert_parallel_size == 2
+    assert ps.get_data_parallel_axes(expert=False) == ("dp", "ep")
+    assert ps.get_data_parallel_axes(expert=True) == ("dp",)
+
+
+def test_invalid_sizes_raise():
+    with pytest.raises(ValueError):
+        ps.initialize_model_parallel(tensor_model_parallel_size=3)
+    with pytest.raises(ValueError):
+        ps.ParallelConfig(tensor_parallel_size=0)
+
+
+def test_getters_require_init():
+    ps.destroy_model_parallel()
+    assert not ps.model_parallel_is_initialized()
+    with pytest.raises(RuntimeError):
+        ps.get_parallel_state()
+    ps.initialize_model_parallel()
+    assert ps.model_parallel_is_initialized()
+    assert ps.get_tensor_model_parallel_size() == 1
+    assert ps.get_data_parallel_size() == len(jax.devices())
