@@ -1,0 +1,113 @@
+"""Vocab-parallel cross-entropy.
+
+TPU-native replacement for the reference's ``parallel_layers/loss_functions.py``
+(``parallel_cross_entropy`` :133, ``_ParallelCrossEntropy`` :11). Keeps the
+reference's 3-collective structure over vocab-sharded logits — max all-reduce
+(:18), predicted-logit mask + all-reduce (:55), sum-exp all-reduce (:67) — as
+a partial-manual shard_map over the tp axis, so the full softmax over the
+global vocab is never materialized on one device. The reference's hand-written
+backward (:103, softmax − one-hot) falls out of JAX autodiff through the psum.
+
+Label smoothing follows loss_functions.py:80-96.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.state import TP_AXIS
+
+
+IGNORE_INDEX = -100  # positions with this label contribute zero loss
+
+
+def _vocab_parallel_xent_body(
+    logits: jax.Array, labels: jax.Array, label_smoothing: float
+) -> jax.Array:
+    """Body over the local vocab shard. logits (..., V_local) f32,
+    labels (...) int."""
+    vl = logits.shape[-1]
+    idx = lax.axis_index(TP_AXIS)
+    vocab_total = vl * lax.axis_size(TP_AXIS)
+    valid = (labels >= 0) & (labels < vocab_total)
+    labels = jnp.where(valid, labels, 0)
+
+    # 1) stable max over the global vocab (reference :18)
+    # pmax has no differentiation rule; the max shift is a constant anyway
+    lmax = lax.pmax(jnp.max(lax.stop_gradient(logits), axis=-1), TP_AXIS)
+    logits = logits - lmax[..., None]
+
+    # 2) predicted logit: mask out-of-shard labels, all-reduce (reference :55)
+    vocab_start = idx * vl
+    local_label = labels - vocab_start
+    in_range = (local_label >= 0) & (local_label < vl)
+    safe = jnp.clip(local_label, 0, vl - 1)
+    pred = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    pred = jnp.where(in_range, pred, 0.0)
+    pred = lax.psum(pred, TP_AXIS)
+
+    # 3) log partition function (reference :67)
+    sumexp = lax.psum(jnp.sum(jnp.exp(logits), axis=-1), TP_AXIS)
+    logz = jnp.log(sumexp)
+
+    loss = logz - pred
+    if label_smoothing > 0.0:
+        # uniform smoothing over the vocab (reference :80-96)
+        mean_logit = lax.psum(jnp.sum(logits, axis=-1), TP_AXIS) / vocab_total
+        smooth_loss = logz - mean_logit
+        loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth_loss
+    return jnp.where(valid, loss, 0.0)
+
+
+def parallel_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Per-token cross-entropy over vocab-sharded logits.
+
+    logits: (..., vocab), last dim tp-sharded (or shardable); labels (...).
+    Returns per-token loss (...), f32. Reference loss_functions.py:133.
+    """
+    logits = logits.astype(jnp.float32)
+    if (
+        not parallel_state.model_parallel_is_initialized()
+        or parallel_state.get_tensor_model_parallel_size() == 1
+    ):
+        return cross_entropy(logits, labels, label_smoothing)
+
+    mesh = parallel_state.get_parallel_state().mesh
+    nd = logits.ndim
+    logits_spec = P(*((None,) * (nd - 1)), TP_AXIS)
+    labels_spec = P(*((None,) * (nd - 1)))
+
+    f = jax.shard_map(
+        lambda lg, lb: _vocab_parallel_xent_body(lg, lb, label_smoothing),
+        mesh=mesh,
+        in_specs=(logits_spec, labels_spec),
+        out_specs=labels_spec,
+        axis_names={TP_AXIS},
+        check_vma=False,
+    )
+    return f(logits, labels)
+
+
+def cross_entropy(
+    logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
+) -> jax.Array:
+    """Unsharded fallback with identical semantics. Labels outside
+    [0, vocab) — including IGNORE_INDEX — contribute zero loss."""
+    logits = logits.astype(jnp.float32)
+    valid = (labels >= 0) & (labels < logits.shape[-1])
+    labels = jnp.where(valid, labels, 0)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    pred = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    loss = logz - pred
+    if label_smoothing > 0.0:
+        mean_logit = jnp.mean(logits, axis=-1)
+        loss = (1.0 - label_smoothing) * loss + label_smoothing * (logz - mean_logit)
+    return jnp.where(valid, loss, 0.0)

@@ -155,6 +155,11 @@ def initialize_model_parallel(
     parallel_state.py:425): Pallas kernels receive mesh axes lexically.
     """
     global _PARALLEL_STATE
+    if _PARALLEL_STATE is not None:
+        raise RuntimeError(
+            "parallel state already initialized; call destroy_model_parallel() "
+            "first (arrays placed on the old mesh would silently mismatch)"
+        )
     config = ParallelConfig(
         tensor_parallel_size=tensor_model_parallel_size,
         pipeline_parallel_size=pipeline_model_parallel_size,

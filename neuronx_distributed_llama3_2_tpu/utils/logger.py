@@ -12,6 +12,24 @@ import os
 import sys
 
 
+class _Rank0Filter(logging.Filter):
+    """Suppress records on non-zero processes, deciding *lazily at emit time*
+    so that importing this package never initializes the JAX backend (which
+    would pin a single-host view before ``jax.distributed.initialize()``)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge._backends:  # backend not up yet: allow
+                return True
+            import jax
+
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+
 def get_logger(name: str = "nxdt", rank0_only: bool = True) -> logging.Logger:
     logger = logging.getLogger(name)
     if getattr(logger, "_nxdt_rank0_only", None) == rank0_only:
@@ -26,13 +44,7 @@ def get_logger(name: str = "nxdt", rank0_only: bool = True) -> logging.Logger:
         logging.Formatter("%(asctime)s [%(levelname)s] %(name)s: %(message)s")
     )
     if rank0_only:
-        try:
-            import jax
-
-            if jax.process_index() != 0:
-                handler.setLevel(logging.CRITICAL)
-        except Exception:
-            pass
+        handler.addFilter(_Rank0Filter())
     logger.addHandler(handler)
     logger.propagate = False
     logger._nxdt_rank0_only = rank0_only  # type: ignore[attr-defined]
