@@ -1,0 +1,5 @@
+from neuronx_distributed_llama3_2_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LLAMA_CONFIGS,
+)

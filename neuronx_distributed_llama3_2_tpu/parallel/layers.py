@@ -230,22 +230,23 @@ class GQAQKVColumnParallelLinear:
     use_bias: bool = False
     dtype: Any = jnp.float32
     kernel_init: Callable = default_kernel_init
-    # Resolved at construction so specs()/__call__ can never disagree with the
-    # layout params were placed with (tp captured from the parallel state; 1
-    # if uninitialized).
+    # Explicit override for tests; None = read the live parallel state. The
+    # lookup is deliberately lazy (per specs()/__call__ invocation) so a layer
+    # constructed before initialize_model_parallel() still resolves the
+    # correct sharded-vs-replicated KV layout once the mesh is up — specs()
+    # and __call__ can't disagree because re-initializing the mesh requires
+    # destroy_model_parallel() + re-placing the params anyway.
     tensor_parallel_size: Optional[int] = None
 
-    def __post_init__(self):
-        if self.tensor_parallel_size is None:
-            tp = (
-                parallel_state.get_tensor_model_parallel_size()
-                if parallel_state.model_parallel_is_initialized()
-                else 1
-            )
-            object.__setattr__(self, "tensor_parallel_size", tp)
+    def _tp(self) -> int:
+        if self.tensor_parallel_size is not None:
+            return self.tensor_parallel_size
+        if parallel_state.model_parallel_is_initialized():
+            return parallel_state.get_tensor_model_parallel_size()
+        return 1
 
     def _kv_sharded(self) -> bool:
-        return self.num_kv_heads % self.tensor_parallel_size == 0
+        return self.num_kv_heads % self._tp() == 0
 
     def init(self, key: jax.Array) -> Params:
         kq, kk, kv = jax.random.split(key, 3)
@@ -289,6 +290,18 @@ class GQAQKVColumnParallelLinear:
         k = constrain(k, _activation_spec(k, kv_axis))
         v = constrain(v, _activation_spec(v, kv_axis))
         return q, k, v
+
+
+def shard_pytree(tree: Any, specs: Any, mesh=None) -> Any:
+    """Place a parameter pytree on the mesh per its spec tree (the runtime
+    counterpart of the reference's ``set_tensor_model_parallel_attributes``
+    tagging + per-rank slicing, utils.py:48 / layers.py:58 — here placement is
+    a device_put of the *global* array with a NamedSharding)."""
+    if mesh is None:
+        mesh = parallel_state.get_parallel_state().mesh
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), tree, specs
+    )
 
 
 def divide(numerator: int, denominator: int) -> int:

@@ -18,13 +18,21 @@ class _Rank0Filter(logging.Filter):
     would pin a single-host view before ``jax.distributed.initialize()``)."""
 
     def filter(self, record: logging.LogRecord) -> bool:
+        if record.levelno >= logging.CRITICAL:
+            return True  # a crashing host must never be silenced
+        import jax
+
         try:
+            # no public "is a backend up yet" probe exists; if this private
+            # one disappears, fall through to process_index() below (correct
+            # filtering, at the cost of forcing backend init at first emit)
             from jax._src import xla_bridge
 
             if not xla_bridge._backends:  # backend not up yet: allow
                 return True
-            import jax
-
+        except (ImportError, AttributeError):
+            pass
+        try:
             return jax.process_index() == 0
         except Exception:
             return True

@@ -19,10 +19,7 @@ def tp4():
 
 
 def _shard_params(layer, params, mesh):
-    specs = layer.specs()
-    return jax.tree_util.tree_map(
-        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
-    )
+    return layers.shard_pytree(params, layer.specs(), mesh)
 
 
 def test_column_row_mlp_parity(tp4):
