@@ -1,0 +1,114 @@
+"""Benchmark: Llama-3.2 1B training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Throughput definition replicates the reference's
+(examples/training/llama/training_utils.py:329-351: moving-window seqs/s,
+converted here to tokens/sec/chip, the BASELINE.json primary metric).
+``vs_baseline`` is measured/target where the target is the BASELINE.md MFU
+north star (≥45% MFU) converted to tokens/sec for this chip+model, since the
+reference repo publishes no absolute numbers (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from neuronx_distributed_llama3_2_tpu.models import LLAMA_CONFIGS, LlamaForCausalLM
+    from neuronx_distributed_llama3_2_tpu.trainer import (
+        OptimizerConfig,
+        TrainingConfig,
+        initialize_parallel_model,
+        make_train_step,
+    )
+    from neuronx_distributed_llama3_2_tpu.trainer.metrics import mfu
+
+    model_cfg = dataclasses.replace(
+        LLAMA_CONFIGS["llama3.2-1b"], remat="full", max_seq_len=2048
+    )
+    batch, seq = 1, 2048
+
+    # Single-chip 1B: pure-bf16 optimizer (no fp32 master — 12 bytes/param of
+    # AdamW state does not fit 16G HBM next to the model; multi-chip ZeRO-1
+    # restores fp32 state by sharding it over dp).
+    config = TrainingConfig(
+        optimizer=OptimizerConfig(
+            zero_one_enabled=False,
+            warmup_steps=1,
+            use_master_weights=False,
+            use_fp32_grad_acc=False,
+            state_dtype="bfloat16",
+        )
+    )
+    config.initialize()
+    model = LlamaForCausalLM(model_cfg)
+    state, _ = initialize_parallel_model(model, config)
+    step = make_train_step(model, config)
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, model_cfg.vocab_size, (batch, seq)),
+        dtype=jnp.int32,
+    )
+    data = {"input_ids": ids, "labels": ids}
+
+    # warmup / compile (block via host transfer: on the axon tunnel backend
+    # block_until_ready returns before execution completes)
+    state, metrics = step(state, data)
+    float(metrics["loss"])
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, data)
+        float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = batch * seq / dt
+
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(state.params))
+    # v5e: 197 TFLOP/s bf16 peak
+    peak = 197e12
+    measured_mfu = mfu(
+        tokens_per_sec,
+        n_params,
+        model_cfg.num_layers,
+        model_cfg.hidden_size,
+        seq,
+        peak,
+    )
+    # target tokens/sec at the BASELINE.md 45%-MFU north star
+    flops_per_token = (
+        6 * n_params + 12 * model_cfg.num_layers * model_cfg.hidden_size * seq
+    )
+    target_tps = 0.45 * peak / flops_per_token
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama3.2-1b_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(tokens_per_sec / target_tps, 4),
+                "detail": {
+                    "mfu": round(measured_mfu, 4),
+                    "step_ms": round(dt * 1000, 2),
+                    "batch": batch,
+                    "seq": seq,
+                    "n_params": n_params,
+                    "chip": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from neuronx_distributed_llama3_2_tpu.kernels.flash_attention import (  # noqa: F401
+    flash_attention,
+)

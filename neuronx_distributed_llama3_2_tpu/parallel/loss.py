@@ -19,7 +19,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
-from neuronx_distributed_llama3_2_tpu.parallel.state import TP_AXIS
+from neuronx_distributed_llama3_2_tpu.parallel.state import DP_AXIS, EP_AXIS, TP_AXIS
 
 
 IGNORE_INDEX = -100  # positions with this label contribute zero loss
@@ -82,15 +82,18 @@ def parallel_cross_entropy(
 
     mesh = parallel_state.get_parallel_state().mesh
     nd = logits.ndim
-    logits_spec = P(*((None,) * (nd - 1)), TP_AXIS)
-    labels_spec = P(*((None,) * (nd - 1)))
+    # leading dim rides the data-parallel axes so dp-sharded logits enter the
+    # shard_map without an all-gather (each dp shard computes only its rows)
+    batch = (DP_AXIS, EP_AXIS) if nd >= 2 else None
+    logits_spec = P(batch, *((None,) * (nd - 2)), TP_AXIS)
+    labels_spec = P(batch, *((None,) * (nd - 2)))
 
     f = jax.shard_map(
         lambda lg, lb: _vocab_parallel_xent_body(lg, lb, label_smoothing),
         mesh=mesh,
         in_specs=(logits_spec, labels_spec),
         out_specs=labels_spec,
-        axis_names={TP_AXIS},
+        axis_names={TP_AXIS, DP_AXIS, EP_AXIS},
         check_vma=False,
     )
     return f(logits, labels)

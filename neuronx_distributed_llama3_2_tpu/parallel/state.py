@@ -230,6 +230,13 @@ def get_data_parallel_axes(expert: bool = False) -> Tuple[str, ...]:
     return (DP_AXIS,) if expert else (DP_AXIS, EP_AXIS)
 
 
+def sequence_parallel_enabled() -> bool:
+    """Whether Megatron-style SP is on (single source of truth for layers)."""
+    return (
+        _PARALLEL_STATE is not None and _PARALLEL_STATE.config.sequence_parallel
+    )
+
+
 def rmsg(msg: str) -> str:
     """Rank-tagged log message (reference parallel_state.py:740). On TPU there
     is a single controller per host; tag with process index."""

@@ -1,0 +1,16 @@
+from neuronx_distributed_llama3_2_tpu.trainer.config import (  # noqa: F401
+    OptimizerConfig,
+    TrainingConfig,
+)
+from neuronx_distributed_llama3_2_tpu.trainer.optimizer import (  # noqa: F401
+    OptimizerState,
+    init_optimizer_state,
+    optimizer_state_specs,
+    apply_gradients,
+)
+from neuronx_distributed_llama3_2_tpu.trainer.trainer import (  # noqa: F401
+    TrainState,
+    initialize_parallel_model,
+    make_train_step,
+    train_state_specs,
+)

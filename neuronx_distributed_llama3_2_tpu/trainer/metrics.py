@@ -1,0 +1,69 @@
+"""Throughput + metrics reporting.
+
+Replicates the reference's measurement definitions so benchmark numbers are
+comparable: ``Throughput`` moving-window seqs/s (examples/training/llama/
+training_utils.py:329-351) and the ``TrainingMetrics`` JSON metrics file
+(training_utils.py:254)."""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Optional
+
+
+class Throughput:
+    """seqs/s = window · (batch·dp·grad_accum) / window_time, moving window
+    (reference training_utils.py:329-351)."""
+
+    def __init__(
+        self,
+        batch_size: int,
+        world_size: int = 1,
+        grad_accum: int = 1,
+        moving_avg_window: int = 10,
+    ):
+        self.seqs_per_iteration = batch_size * world_size * grad_accum
+        self.window = moving_avg_window
+        self.times: deque = deque(maxlen=moving_avg_window + 1)
+
+    def tick(self) -> Optional[float]:
+        """Record an iteration boundary; return seqs/s over the window (None
+        until the window has 2+ points)."""
+        self.times.append(time.perf_counter())
+        if len(self.times) < 2:
+            return None
+        span = self.times[-1] - self.times[0]
+        iters = len(self.times) - 1
+        return self.seqs_per_iteration * iters / span
+
+
+class TrainingMetrics:
+    """Append-only JSON-lines metrics file (reference TrainingMetrics
+    training_utils.py:254 stores a json document; we use jsonl for
+    crash-robust appends)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def log(self, step: int, **metrics):
+        rec = {"step": step, "ts": time.time(), **metrics}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+def mfu(
+    tokens_per_sec: float,
+    num_params: int,
+    num_layers: int,
+    hidden_size: int,
+    seq_len: int,
+    peak_flops_per_chip: float,
+    num_chips: int = 1,
+) -> float:
+    """Model FLOPs utilization with the standard 6N + attention correction
+    (per-token train FLOPs ≈ 6·N + 12·L·H·S)."""
+    flops_per_token = 6 * num_params + 12 * num_layers * hidden_size * seq_len
+    achieved = tokens_per_sec * flops_per_token
+    return achieved / (peak_flops_per_chip * num_chips)

@@ -1,0 +1,176 @@
+"""High-level training facade.
+
+TPU-native replacement for the reference trainer layer
+(``trainer/trainer.py``): ``initialize_parallel_model`` (:141, the 6-phase
+meta-device-init → wrap → materialize assembly) collapses to a jit-ed
+initializer with output shardings — parameters materialize *directly sharded
+on the mesh*, which is the reference's ``meta_device_init`` +
+``get_model_sequential`` staged host-RAM dance (model_utils.py:245,320) made
+unnecessary. ``make_train_step`` is the canonical train loop body
+(tp_zero1_llama_hf_pretrain.py:277-350): microbatched grad accumulation (fp32),
+optimizer step, metrics — one compiled XLA program with donated state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.state import DP_AXIS, EP_AXIS
+from neuronx_distributed_llama3_2_tpu.trainer.config import TrainingConfig
+from neuronx_distributed_llama3_2_tpu.trainer.optimizer import (
+    OptimizerState,
+    apply_gradients,
+    init_optimizer_state,
+    optimizer_state_specs,
+)
+
+BATCH_AXES = (DP_AXIS, EP_AXIS)
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt: OptimizerState
+
+
+def train_state_specs(model, config: TrainingConfig, params: Any) -> TrainState:
+    pspecs = model.specs()
+    return TrainState(
+        params=pspecs,
+        opt=optimizer_state_specs(pspecs, params, config.optimizer),
+    )
+
+
+def initialize_parallel_model(
+    model,
+    config: TrainingConfig,
+    key: Optional[jax.Array] = None,
+) -> Tuple[TrainState, TrainState]:
+    """Build a fully sharded TrainState. Returns (state, state_specs).
+
+    The init function is jit-compiled with ``out_shardings`` derived from the
+    model's spec tree, so each device only ever materializes its own shard —
+    the reference needs meta-device init + sequential materialization
+    (trainer/trainer.py:141-229, model_utils.py:320) to avoid host OOM; here
+    XLA never builds the unsharded model anywhere.
+    """
+    if key is None:
+        key = jax.random.key(config.seed)
+    mesh = parallel_state.get_parallel_state().mesh
+
+    def init_fn(key):
+        params = model.init(key)
+        opt = init_optimizer_state(params, config.optimizer)
+        return TrainState(params=params, opt=opt)
+
+    abstract = jax.eval_shape(init_fn, key)
+    specs = TrainState(
+        params=model.specs(),
+        opt=optimizer_state_specs(
+            model.specs(), abstract.params, config.optimizer
+        ),
+    )
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+    state = jax.jit(init_fn, out_shardings=shardings)(key)
+    return state, specs
+
+
+def default_weight_decay_mask(params: Any) -> Any:
+    """True where weight decay applies: skip norms scales and biases
+    (the reference examples' two param groups,
+    tp_zero1_llama_hf_pretrain.py optimizer_grouped_parameters pattern)."""
+
+    def decide(path, leaf):
+        keys = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+        joined = "/".join(str(k) for k in keys).lower()
+        if "norm" in joined or "bias" in joined or "scale" in joined:
+            return False
+        return leaf.ndim >= 2
+
+    return jax.tree_util.tree_map_with_path(decide, params)
+
+
+def make_train_step(
+    model,
+    config: TrainingConfig,
+) -> Callable:
+    """Compiled train step: (state, batch) -> (state, metrics).
+
+    batch = {"input_ids": (GBS, S) int32, "labels": (GBS, S) int32}; GBS is
+    split into ``config.num_microbatches`` sequential microbatches whose
+    gradients accumulate in fp32 (reference grad-accum loop +
+    use_fp32_grad_acc, tp_zero1_llama_hf_pretrain.py:277-350). The whole step
+    is ONE XLA program — no per-microbatch graph breaks (the reference pays a
+    mark_step per accumulation step).
+    """
+    opt_cfg = config.optimizer
+    n_micro = config.num_microbatches
+
+    def loss_fn(params, input_ids, labels):
+        return model.loss(params, input_ids, labels)
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def train_step(state: TrainState, batch) -> Tuple[TrainState, dict]:
+        input_ids, labels = batch["input_ids"], batch["labels"]
+        input_ids = jax.lax.with_sharding_constraint(
+            input_ids,
+            NamedSharding(
+                parallel_state.get_parallel_state().mesh, P(BATCH_AXES, None)
+            ),
+        )
+        if n_micro == 1:
+            loss, grads = grad_fn(state.params, input_ids, labels)
+            if opt_cfg.use_fp32_grad_acc:
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        else:
+            gbs = input_ids.shape[0]
+            mbs = gbs // n_micro
+            mb_ids = input_ids.reshape(n_micro, mbs, -1)
+            mb_lbl = labels.reshape(n_micro, mbs, -1)
+            acc_dtype = jnp.float32 if opt_cfg.use_fp32_grad_acc else None
+
+            def micro(carry, mb):
+                acc, loss_acc = carry
+                ids, lbl = mb
+                loss, grads = grad_fn(state.params, ids, lbl)
+                acc = jax.tree.map(
+                    lambda a, g: a + (g.astype(a.dtype)), acc, grads
+                )
+                return (acc, loss_acc + loss), None
+
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(
+                    p.shape, acc_dtype or p.dtype
+                ),
+                state.params,
+            )
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro, (zero, jnp.float32(0)), (mb_ids, mb_lbl)
+            )
+            grads = jax.tree.map(lambda g: g / n_micro, grads)
+            loss = loss_sum / n_micro
+
+        new_params, new_opt, grad_norm = apply_gradients(
+            state.opt,
+            grads,
+            state.params,
+            opt_cfg,
+            weight_decay_mask=default_weight_decay_mask(state.params),
+        )
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "grad_norm": grad_norm,
+            "learning_rate": opt_cfg.lr_at(new_opt.step),
+            "step": new_opt.step,
+        }
+        return TrainState(params=new_params, opt=new_opt), metrics
+
+    return jax.jit(train_step, donate_argnums=0)

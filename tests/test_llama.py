@@ -137,6 +137,53 @@ def test_remat_matches(hf_model, batch):
         )
 
 
+def test_rope_scaling_matches_hf(batch):
+    """llama3-style rope_scaling (the published Llama-3.2 config) produces
+    HF-identical logits."""
+    import dataclasses
+
+    import torch
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers import LlamaForCausalLM as HFLlama
+
+    cfg = dataclasses.replace(TINY, rope_scaling=(32.0, 1.0, 4.0, 16))
+    hf_cfg = HFLlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+        max_position_embeddings=cfg.max_seq_len, rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_norm_eps, tie_word_embeddings=True,
+        attention_bias=False, mlp_bias=False,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 32.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 16,
+        },
+    )
+    torch.manual_seed(0)
+    hf = HFLlama(hf_cfg).eval()
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(batch).long()).logits.numpy()
+    model = LlamaForCausalLM(cfg)
+    params = params_from_hf(hf.state_dict(), cfg)
+    logits = jax.jit(model.__call__)(params, jnp.asarray(batch))
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, atol=1e-3)
+
+
+def test_flash_attention_path(hf_model, batch):
+    """use_flash_attention=True matches the dense-attention model
+    (reference nki_flash_attn_func opt-in parity)."""
+    import dataclasses
+
+    params = params_from_hf(hf_model.state_dict(), TINY)
+    ids = jnp.asarray(batch)
+    ref = jax.jit(LlamaForCausalLM(TINY).__call__)(params, ids)
+    flash_cfg = dataclasses.replace(TINY, use_flash_attention=True)
+    out = jax.jit(LlamaForCausalLM(flash_cfg).__call__)(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
 def test_init_shapes():
     model = LlamaForCausalLM(TINY)
     params = model.init(jax.random.key(0))
