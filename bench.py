@@ -29,7 +29,10 @@ def main():
         initialize_parallel_model,
         make_train_step,
     )
-    from neuronx_distributed_llama3_2_tpu.trainer.metrics import mfu
+    from neuronx_distributed_llama3_2_tpu.trainer.metrics import (
+        mfu,
+        train_flops_per_token,
+    )
 
     model_cfg = dataclasses.replace(
         LLAMA_CONFIGS["llama3.2-1b"], remat="full", max_seq_len=2048
@@ -85,10 +88,9 @@ def main():
         peak,
     )
     # target tokens/sec at the BASELINE.md 45%-MFU north star
-    flops_per_token = (
-        6 * n_params + 12 * model_cfg.num_layers * model_cfg.hidden_size * seq
+    target_tps = 0.45 * peak / train_flops_per_token(
+        n_params, model_cfg.num_layers, model_cfg.hidden_size, seq
     )
-    target_tps = 0.45 * peak / flops_per_token
 
     print(
         json.dumps(

@@ -1,0 +1,11 @@
+from neuronx_distributed_llama3_2_tpu.checkpoint.storage import (  # noqa: F401
+    BaseCheckpointStorage,
+    FilesysCheckpointStorage,
+    create_checkpoint_storage,
+)
+from neuronx_distributed_llama3_2_tpu.checkpoint.checkpoint import (  # noqa: F401
+    CheckpointIOState,
+    load_checkpoint,
+    save_checkpoint,
+    finalize_async_saves,
+)

@@ -83,10 +83,21 @@ def parallel_cross_entropy(
     mesh = parallel_state.get_parallel_state().mesh
     nd = logits.ndim
     # leading dim rides the data-parallel axes so dp-sharded logits enter the
-    # shard_map without an all-gather (each dp shard computes only its rows)
-    batch = (DP_AXIS, EP_AXIS) if nd >= 2 else None
-    logits_spec = P(batch, *((None,) * (nd - 2)), TP_AXIS)
-    labels_spec = P(batch, *((None,) * (nd - 2)))
+    # shard_map without an all-gather (each dp shard computes only its rows);
+    # fall back to a replicated batch when it doesn't divide (eval/tail batch)
+    st = parallel_state.get_parallel_state()
+    dp_total = st.data_parallel_size
+    batch = (
+        (DP_AXIS, EP_AXIS)
+        if nd >= 2 and logits.shape[0] % dp_total == 0
+        else None
+    )
+    if nd >= 2:
+        logits_spec = P(batch, *((None,) * (nd - 2)), TP_AXIS)
+        labels_spec = P(batch, *((None,) * (nd - 2)))
+    else:
+        logits_spec = P(TP_AXIS)
+        labels_spec = P()
 
     f = jax.shard_map(
         lambda lg, lb: _vocab_parallel_xent_body(lg, lb, label_smoothing),

@@ -53,6 +53,14 @@ class TrainingMetrics:
             f.write(json.dumps(rec) + "\n")
 
 
+def train_flops_per_token(
+    num_params: int, num_layers: int, hidden_size: int, seq_len: int
+) -> float:
+    """Per-token training FLOPs: the standard 6N plus attention correction
+    (≈ 6·N + 12·L·H·S). Single source of truth for MFU and bench targets."""
+    return 6 * num_params + 12 * num_layers * hidden_size * seq_len
+
+
 def mfu(
     tokens_per_sec: float,
     num_params: int,
@@ -62,8 +70,8 @@ def mfu(
     peak_flops_per_chip: float,
     num_chips: int = 1,
 ) -> float:
-    """Model FLOPs utilization with the standard 6N + attention correction
-    (per-token train FLOPs ≈ 6·N + 12·L·H·S)."""
-    flops_per_token = 6 * num_params + 12 * num_layers * hidden_size * seq_len
-    achieved = tokens_per_sec * flops_per_token
+    """Model FLOPs utilization."""
+    achieved = tokens_per_sec * train_flops_per_token(
+        num_params, num_layers, hidden_size, seq_len
+    )
     return achieved / (peak_flops_per_chip * num_chips)

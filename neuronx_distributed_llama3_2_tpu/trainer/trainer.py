@@ -133,8 +133,12 @@ def make_train_step(
         else:
             gbs = input_ids.shape[0]
             mbs = gbs // n_micro
-            mb_ids = input_ids.reshape(n_micro, mbs, -1)
-            mb_lbl = labels.reshape(n_micro, mbs, -1)
+            # strided split (row m of microbatch k = global row k + m*n_micro)
+            # so every dp shard's contiguous rows contribute to every
+            # microbatch — a contiguous reshape would concentrate each
+            # microbatch on a dp subset and force a resharding all-to-all
+            mb_ids = input_ids.reshape(mbs, n_micro, -1).swapaxes(0, 1)
+            mb_lbl = labels.reshape(mbs, n_micro, -1).swapaxes(0, 1)
             acc_dtype = jnp.float32 if opt_cfg.use_fp32_grad_acc else None
 
             def micro(carry, mb):
