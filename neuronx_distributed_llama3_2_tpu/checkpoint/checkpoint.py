@@ -176,11 +176,17 @@ _IO_STATES: Dict[str, CheckpointIOState] = {}
 
 
 def _io_state(storage: BaseCheckpointStorage, async_save: bool) -> CheckpointIOState:
+    """One IO state per checkpoint root for the process lifetime — replacing
+    it would orphan in-flight writer threads (whose tag the next save's GC
+    would then delete mid-write). The async flag is per-save: flipping it is
+    safe because save_checkpoint wait_all()s before begin()."""
     key = storage.dirname()
     st = _IO_STATES.get(key)
-    if st is None or st.async_save != async_save:
+    if st is None:
         st = CheckpointIOState(storage, async_save)
         _IO_STATES[key] = st
+    else:
+        st.async_save = async_save
     return st
 
 
@@ -281,10 +287,17 @@ def _load_tree(
     spec_leaves = (
         [None] * len(keys)
         if specs is None
+        # None is a valid "replicated" spec leaf — without is_leaf catching
+        # it, tree_flatten drops it as an empty subtree and misaligns the zip
         else jax.tree_util.tree_flatten(
-            specs, is_leaf=lambda s: isinstance(s, PartitionSpec)
+            specs, is_leaf=lambda s: s is None or isinstance(s, PartitionSpec)
         )[0]
     )
+    if len(spec_leaves) != len(keys):
+        raise ValueError(
+            f"specs tree has {len(spec_leaves)} leaves but template has "
+            f"{len(keys)}"
+        )
     out = []
     for key, tmpl, spec in zip(keys, flat_template, spec_leaves):
         entry = manifest.get(key)

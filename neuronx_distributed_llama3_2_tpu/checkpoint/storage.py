@@ -196,8 +196,18 @@ class S3CheckpointStorage(BaseCheckpointStorage):
         try:
             self._client.head_object(Bucket=self._bucket, Key=self._key(filename))
             return True
-        except botocore.exceptions.ClientError:
-            return False
+        except botocore.exceptions.ClientError as e:
+            # only a true 404 means "absent"; throttling/5xx/403 must not be
+            # mistaken for a missing 'done' marker (GC would delete a valid
+            # checkpoint)
+            code = e.response.get("ResponseMetadata", {}).get("HTTPStatusCode")
+            if code == 404 or e.response.get("Error", {}).get("Code") in (
+                "404",
+                "NoSuchKey",
+                "NotFound",
+            ):
+                return False
+            raise
 
     def dir_exists(self, dirname: str) -> bool:
         resp = self._client.list_objects_v2(

@@ -492,13 +492,11 @@ class LlamaForCausalLM:
         training prefer :meth:`loss` (vocab stays sharded)."""
         return self._logits(params, self._backbone(params, input_ids))
 
-    def loss(
-        self, params: Params, input_ids: jax.Array, labels: jax.Array
+    def loss_from_hidden(
+        self, params: Params, hidden: jax.Array, labels: jax.Array
     ) -> jax.Array:
-        """Mean next-token cross-entropy. ``labels`` aligned with
-        ``input_ids`` (HF convention: shift happens here, loss on positions
-        predicting labels[:, 1:])."""
-        hidden = self._backbone(params, input_ids)
+        """Shared LM-head + masked-mean CE tail (used by the pipelined model
+        too, so masking semantics can never diverge)."""
         logits = self._logits(params, hidden[:, :-1, :])
         shifted = labels[:, 1:]
         per_tok = parallel_cross_entropy(logits, shifted)
@@ -508,6 +506,16 @@ class LlamaForCausalLM:
             (shifted >= 0) & (shifted < self.config.vocab_size)
         ).astype(jnp.float32)
         return jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+    def loss(
+        self, params: Params, input_ids: jax.Array, labels: jax.Array
+    ) -> jax.Array:
+        """Mean next-token cross-entropy. ``labels`` aligned with
+        ``input_ids`` (HF convention: shift happens here, loss on positions
+        predicting labels[:, 1:])."""
+        return self.loss_from_hidden(
+            params, self._backbone(params, input_ids), labels
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -55,10 +55,18 @@ def _activation_spec(y: jax.Array, last_axis) -> P:
 
 def constrain(x: jax.Array, spec: P) -> jax.Array:
     """Apply a sharding constraint if parallel state is initialized (no-op
-    otherwise, so layers also run un-meshed in pure single-device tests)."""
+    otherwise, so layers also run un-meshed in pure single-device tests).
+
+    Inside a partial-manual ``shard_map`` (e.g. the pipeline executor, manual
+    over pp only) the constraint must be built against the *ambient abstract
+    mesh* — whose manual axes are marked — not the concrete mesh; auto axes
+    (tp/dp/ep) keep working there."""
     if not parallel_state.model_parallel_is_initialized():
         return x
     mesh = parallel_state.get_parallel_state().mesh
+    ambient = jax.sharding.get_abstract_mesh()
+    if ambient is not None and not ambient.empty:
+        mesh = ambient
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 

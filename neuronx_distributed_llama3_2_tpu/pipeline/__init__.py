@@ -1,0 +1,8 @@
+from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (  # noqa: F401
+    InferenceSchedule,
+    Train1F1BSchedule,
+    TrainGPipeSchedule,
+)
+from neuronx_distributed_llama3_2_tpu.pipeline.model import (  # noqa: F401
+    PipelinedCausalLM,
+)

@@ -1,0 +1,212 @@
+"""SPMD pipeline-parallel causal LM.
+
+TPU-native replacement for the reference's PP runtime (``pipeline/model.py``
+``NxDPPModel`` :54 + ``pipeline/comm.py`` + ``pipeline/partition.py``). The
+reference needs ~3.3K LoC because torch-xla is MPMD: FX-trace the model, split
+the graph per rank (partition.py:18), emulate p2p send/recv with 2-rank
+all-gathers (comm.py:38-92), exchange shape metadata over TCPStore
+(comm.py:130-197), and execute a per-rank task list with one XLA graph per
+task (model.py:1382). Under single-program SPMD all of that collapses to:
+
+- **partition** = reshape the stacked layer params (L, ...) →
+  (pp, L/pp, ...) and shard dim 0 over the ``pp`` mesh axis (the reference's
+  ``create_partitions`` even split, partition.py:280);
+- **p2p** = ``jnp.roll`` of the pp-sharded microbatch stream, which XLA
+  lowers to a neighbor ``collective-permute`` over ICI — real p2p, not the
+  all-gather trick (SURVEY.md §5 backend note);
+- **schedule** = one ``lax.scan`` over ``num_microbatches + pp - 1`` rotations
+  (GPipe pipelining, :class:`..pipeline.scheduler.TrainGPipeSchedule`);
+  the backward pipeline falls out of autodiff through the scan in reverse.
+  Per-microbatch activation memory is bounded by the model's remat policy —
+  the role 1F1B plays on the reference's runtime;
+- **shared embedding** (tied embeddings used by stage 0 and the head) needs
+  no grad-sync machinery (reference ``analyze_shared_weights_across_stages``
+  partition.py:232 / ``_reduce_shared_weights`` model.py:620): it is one
+  global parameter used twice, GSPMD sums its gradient contributions.
+
+Bubble fraction is (pp-1)/(M+pp-1) like GPipe; choose num_microbatches ≥ 4·pp
+to amortize (same guidance as the reference's 1F1B).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LlamaForCausalLM,
+    _remat_policy,
+    precompute_rope,
+)
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.layers import BATCH_AXES, constrain
+from neuronx_distributed_llama3_2_tpu.parallel.state import PP_AXIS, TP_AXIS
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelinedCausalLM:
+    """Pipeline wrapper around :class:`LlamaForCausalLM` with the same
+    init/specs/loss interface, so the trainer and checkpoint layers work
+    unchanged (the uniform-facade role of the reference's NxDModel,
+    trainer/model.py:8)."""
+
+    model: LlamaForCausalLM
+    num_microbatches: int
+
+    @property
+    def config(self):
+        return self.model.config
+
+    def _pp(self) -> int:
+        return parallel_state.get_pipeline_model_parallel_size()
+
+    def _layers_per_stage(self) -> int:
+        L, pp = self.config.num_layers, self._pp()
+        if L % pp != 0:
+            raise ValueError(f"num_layers {L} not divisible by pp {pp}")
+        return L // pp
+
+    # -- parameter layout ------------------------------------------------
+
+    def to_pipeline(self, params: Params) -> Params:
+        """(L, ...) stacked layers → (pp, L/pp, ...). Stage s owns layers
+        [s·L/pp, (s+1)·L/pp) — the reference's even auto-partition
+        (partition.py:280, model.py:306-318)."""
+        pp, lps = self._pp(), self._layers_per_stage()
+        out = dict(params)
+        out["layers"] = jax.tree.map(
+            lambda p: p.reshape(pp, lps, *p.shape[1:]), params["layers"]
+        )
+        return out
+
+    def from_pipeline(self, params: Params) -> Params:
+        L = self.config.num_layers
+        out = dict(params)
+        out["layers"] = jax.tree.map(
+            lambda p: p.reshape(L, *p.shape[2:]), params["layers"]
+        )
+        return out
+
+    def init(self, key: jax.Array) -> Params:
+        return self.to_pipeline(self.model.init(key))
+
+    def specs(self) -> Params:
+        base = self.model.specs()
+        out = dict(base)
+        # layer leaves are P(None, *per-layer); pipeline adds the pp axis on
+        # the stage dim: P("pp", None, *per-layer)
+        out["layers"] = jax.tree.map(
+            lambda s: P(PP_AXIS, *s),
+            base["layers"],
+            is_leaf=lambda s: isinstance(s, P),
+        )
+        return out
+
+    # -- execution -------------------------------------------------------
+
+    def _stage_apply(self, stage_layers, stream, sin, cos, positions):
+        """Every stage applies its layer block to its current microbatch.
+        shard_map manual over pp only; tp/sp/dp shardings inside the stage
+        body remain GSPMD-auto, so the per-layer constraints keep working."""
+        cfg = self.config
+        layer = self.model._layer()
+        mesh = parallel_state.get_parallel_state().mesh
+        policy = _remat_policy(cfg.remat)
+
+        def body(stage_layers_l, stream_l, sin, cos, positions):
+            x = stream_l[0]  # (mbs, S, H) — this stage's microbatch
+            lp = jax.tree.map(lambda p: p[0], stage_layers_l)
+
+            def layer_body(x, one_layer):
+                return layer(one_layer, x, sin, cos, positions), None
+
+            if policy is not None:
+                layer_body = jax.checkpoint(layer_body, policy=policy)
+            x, _ = lax.scan(layer_body, x, lp)
+            return x[None]
+
+        layer_specs = jax.tree.map(
+            lambda _: P(PP_AXIS),
+            stage_layers,
+        )
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(layer_specs, P(PP_AXIS), P(), P(), P()),
+            out_specs=P(PP_AXIS),
+            axis_names={PP_AXIS},
+            check_vma=False,
+        )(stage_layers, stream, sin, cos, positions)
+
+    def _pipeline_hidden(self, params: Params, input_ids: jax.Array) -> jax.Array:
+        """Embed → pipelined decoder stack → (B, S, H) hidden states."""
+        cfg = self.config
+        pp, M = self._pp(), self.num_microbatches
+        gbs, S = input_ids.shape
+        if gbs % M != 0:
+            raise ValueError(f"batch {gbs} not divisible by microbatches {M}")
+        mbs = gbs // M
+
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mbs, S))
+        sin, cos = precompute_rope(
+            cfg.head_dim, S, cfg.rope_theta, cfg.rope_scaling
+        )
+
+        x = self.model._embed()(params["embed"], input_ids)  # (GBS, S, H)
+        # strided microbatch split (see trainer.make_train_step): microbatch
+        # m = rows m::M, keeping every dp shard present in every microbatch
+        x_mb = x.reshape(mbs, M, S, -1).swapaxes(0, 1)  # (M, mbs, S, H)
+        x_mb = constrain(x_mb, P(None, BATCH_AXES, None, None))
+
+        stream = jnp.zeros((pp, mbs, S, x.shape[-1]), cfg.dtype)
+        out_buf = jnp.zeros((M, mbs, S, x.shape[-1]), cfg.dtype)
+
+        def rotate(carry, t):
+            stream, out_buf = carry
+            # inject the next microbatch into stage 0; the clamped reads past
+            # M feed garbage whose outputs never reach out_buf (they would
+            # arrive after the last rotation)
+            inject = lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+            )
+            # neighbor shift stage s-1 → s: lowers to collective-permute over
+            # the pp axis (the reference's emulated send/recv, comm.py:38-92)
+            stream = jnp.roll(stream, 1, axis=0)
+            stream = lax.dynamic_update_index_in_dim(
+                stream, inject.astype(cfg.dtype), 0, axis=0
+            )
+            stream = constrain(stream, P(PP_AXIS, BATCH_AXES, None, None))
+            stream = self._stage_apply(
+                params["layers"], stream, sin, cos, positions
+            )
+            out = lax.index_in_dim(stream, pp - 1, axis=0, keepdims=False)
+            # writes for t < pp-1 land on index 0 and are overwritten by the
+            # first valid write (t = pp-1) before any later index is touched
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, out, jnp.clip(t - (pp - 1), 0, M - 1), axis=0
+            )
+            return (stream, out_buf), None
+
+        (stream, out_buf), _ = lax.scan(
+            rotate, (stream, out_buf), jnp.arange(M + pp - 1)
+        )
+        # undo the strided microbatch split
+        hidden = out_buf.swapaxes(0, 1).reshape(gbs, S, -1)
+        return self.model._norm()(params["final_norm"], hidden)
+
+    def __call__(self, params: Params, input_ids: jax.Array) -> jax.Array:
+        hidden = self._pipeline_hidden(params, input_ids)
+        return self.model._logits(params, hidden)
+
+    def loss(
+        self, params: Params, input_ids: jax.Array, labels: jax.Array
+    ) -> jax.Array:
+        hidden = self._pipeline_hidden(params, input_ids)
+        return self.model.loss_from_hidden(params, hidden, labels)

@@ -1,0 +1,180 @@
+"""Pipeline schedules as pure logic.
+
+Port of the *role* of the reference's declarative schedule layer
+(``pipeline/scheduler.py``: ``PipeSchedule`` ABC :73, ``InferenceSchedule``
+:144, ``Train1F1BSchedule`` :157 with pp-rank-dependent warmup :180, steady
+1F1B ``_step_to_micro_batch`` :186, cooldown, and the
+recv-bwd-before-send-fwd deadlock-avoidance ordering :227-233). Like the
+reference's, this module is hardware-free and unit-testable in isolation
+(SURVEY.md §4 — scheduler equivalence tests).
+
+Role on TPU: the SPMD executor (:mod:`.model`) compiles a GPipe-equivalent
+schedule directly into one XLA program, where XLA's static scheduling replaces
+task lists. These task lists remain the *specification* used by the tests to
+validate the executor's timing (bubble count, per-stage utilization) and are
+the contract for a future multi-controller runtime where stages are separate
+programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineTask:
+    """One unit of per-rank work (reference task classes scheduler.py:4-70)."""
+
+    mb: int  # microbatch index
+
+
+@dataclasses.dataclass(frozen=True)
+class ForwardStepTask(PipelineTask):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BackwardStepTask(PipelineTask):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RecvForwardTask(PipelineTask):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SendForwardTask(PipelineTask):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RecvBackwardTask(PipelineTask):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SendBackwardTask(PipelineTask):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceGradsTask(PipelineTask):
+    pass
+
+
+class PipeSchedule:
+    """Yields, per wall-clock step, the ordered task list of one pp rank
+    (reference PipeSchedule scheduler.py:73)."""
+
+    def __init__(self, num_microbatches: int, pp_size: int, pp_rank: int):
+        if not 0 <= pp_rank < pp_size:
+            raise ValueError(f"pp_rank {pp_rank} out of range [0, {pp_size})")
+        self.num_microbatches = num_microbatches
+        self.pp_size = pp_size
+        self.pp_rank = pp_rank
+
+    @property
+    def is_first(self) -> bool:
+        return self.pp_rank == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.pp_rank == self.pp_size - 1
+
+    def steps(self) -> Iterator[List[PipelineTask]]:
+        raise NotImplementedError
+
+    def flat_tasks(self) -> List[PipelineTask]:
+        return [t for step in self.steps() for t in step]
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only (reference scheduler.py:144)."""
+
+    def steps(self):
+        for mb in range(self.num_microbatches):
+            tasks: List[PipelineTask] = []
+            if not self.is_first:
+                tasks.append(RecvForwardTask(mb))
+            tasks.append(ForwardStepTask(mb))
+            if not self.is_last:
+                tasks.append(SendForwardTask(mb))
+            yield tasks
+
+
+class TrainGPipeSchedule(PipeSchedule):
+    """All forwards, then all backwards (the schedule the SPMD executor
+    compiles; equivalent to the reference's deprecated ``TrainSchedule``
+    scheduler.py:545, kept there as the test oracle)."""
+
+    def steps(self):
+        for mb in range(self.num_microbatches):
+            tasks: List[PipelineTask] = []
+            if not self.is_first:
+                tasks.append(RecvForwardTask(mb))
+            tasks.append(ForwardStepTask(mb))
+            if not self.is_last:
+                tasks.append(SendForwardTask(mb))
+            yield tasks
+        for mb in range(self.num_microbatches):
+            tasks = []
+            if not self.is_last:
+                tasks.append(RecvBackwardTask(mb))
+            tasks.append(BackwardStepTask(mb))
+            if not self.is_first:
+                tasks.append(SendBackwardTask(mb))
+            yield tasks
+        yield [ReduceGradsTask(-1)]
+
+
+class Train1F1BSchedule(PipeSchedule):
+    """1F1B (reference Train1F1BSchedule scheduler.py:157): warmup of
+    ``pp_size - pp_rank - 1`` forwards (:180), steady-state alternating
+    1F1B, cooldown backwards. Recv-backward is ordered *before* send-forward
+    in the steady state (:227-233) — on the reference's runtime the reversed
+    order deadlocks the collectives; our SPMD executor has no such hazard but
+    the task order is preserved as the specification."""
+
+    @property
+    def num_warmup(self) -> int:
+        return min(self.pp_size - self.pp_rank - 1, self.num_microbatches)
+
+    def steps(self):
+        n, warmup = self.num_microbatches, self.num_warmup
+        steady = n - warmup
+        # warmup forwards
+        for mb in range(warmup):
+            tasks: List[PipelineTask] = []
+            if not self.is_first:
+                tasks.append(RecvForwardTask(mb))
+            tasks.append(ForwardStepTask(mb))
+            if not self.is_last:
+                tasks.append(SendForwardTask(mb))
+            yield tasks
+        # steady 1F1B: fwd mb = warmup + i, bwd mb = i
+        for i in range(steady):
+            fwd_mb = warmup + i
+            tasks = []
+            if not self.is_first:
+                tasks.append(RecvForwardTask(fwd_mb))
+            tasks.append(ForwardStepTask(fwd_mb))
+            if not self.is_last:
+                # deadlock-avoidance order (reference scheduler.py:227-233)
+                tasks.append(RecvBackwardTask(i))
+                tasks.append(SendForwardTask(fwd_mb))
+            tasks.append(BackwardStepTask(i))
+            if not self.is_first:
+                tasks.append(SendBackwardTask(i))
+            yield tasks
+        # cooldown backwards
+        for mb in range(steady, n):
+            tasks = []
+            if not self.is_last:
+                tasks.append(RecvBackwardTask(mb))
+            tasks.append(BackwardStepTask(mb))
+            if not self.is_first:
+                tasks.append(SendBackwardTask(mb))
+            yield tasks
+        yield [ReduceGradsTask(-1)]
