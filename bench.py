@@ -35,9 +35,13 @@ def main():
     )
 
     model_cfg = dataclasses.replace(
-        LLAMA_CONFIGS["llama3.2-1b"], remat="full", max_seq_len=2048
+        LLAMA_CONFIGS["llama3.2-1b"],
+        remat="full",
+        max_seq_len=2048,
+        use_flash_attention=True,
+        loss_chunk_size=512,
     )
-    batch, seq = 1, 2048
+    batch, seq = 12, 2048
 
     # Single-chip 1B: pure-bf16 optimizer (no fp32 master — 12 bytes/param of
     # AdamW state does not fit 16G HBM next to the model; multi-chip ZeRO-1

@@ -1,0 +1,424 @@
+"""Pallas TPU flash-attention kernels (forward + backward).
+
+TPU-native replacement for the reference's NKI device kernels
+(``kernels/flash_attn.py``: ``flash_fwd`` / ``flash_attn_bwd`` :20, bound via
+``nki_flash_attn_func`` :151). FlashAttention-2 structure:
+
+- forward: grid (batch·q_heads, q_blocks, kv_blocks), kv innermost so the
+  running max/denominator/accumulator live in VMEM scratch across kv
+  iterations; causal blocks above the diagonal are predicated off entirely
+  (the reference kernel does the same block-skip). Emits the logsumexp so
+  the backward never re-materializes the softmax normalizer.
+- backward: two kernels — dq (grid over q blocks, accumulating across kv)
+  and dk/dv (grid over kv blocks, accumulating across q), recomputing P from
+  (q, k, lse) flash-style.
+- GQA: q head h reads kv head h // group through the BlockSpec index map —
+  no KV replication in memory (the reference replicates KV heads
+  ``kv_size_multiplier`` times instead, qkv_linear.py:454).
+
+Unlike the NKI kernel's seq % 2048 == 0 constraint (flash_attn.py:178), any
+seq length is accepted: the wrapper pads to the block size and masks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_KV = 256
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, causal: bool, sm_scale: float, block_q: int, block_kv: int,
+    kv_len: int,
+):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    kv_start = ki * block_kv
+    # causal: skip blocks fully above the diagonal
+    run = True if not causal else kv_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)  # (bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+
+        kv_pos = kv_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kv_pos < kv_len
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (kv_pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, 0]  # (bq,)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        # rows with no valid key yet keep m = -inf; exp(-inf - -inf) guarded
+        alpha = jnp.where(
+            m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new)
+        )
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(mask, p, 0.0)
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        v = v_ref[0, 0]  # (bk, D)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + pv
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+        m = m_scr[:, 0]
+        lse = jnp.where(m == NEG_INF, NEG_INF, m + jnp.log(safe_l))
+        lse_ref[0, 0, :, 0] = lse
+
+
+def _pad_to(x, size, axis):
+    pad = -x.shape[axis] % size
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _interpret() -> bool:
+    # CPU (tests / virtual mesh): run kernels in the pallas interpreter
+    return jax.default_backend() != "tpu"
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv):
+    """q (B, N, Sq, D), k/v (B, Nkv, Skv, D) → o (B, N, Sq, D), lse (B, N, Sq)."""
+    b, n, sq, d = q.shape
+    nkv, skv = k.shape[1], k.shape[2]
+    group = n // nkv
+
+    qp = _pad_to(q, block_q, 2)
+    kp = _pad_to(k, block_kv, 2)
+    vp = _pad_to(v, block_kv, 2)
+    sq_p, skv_p = qp.shape[2], kp.shape[2]
+    nq, nk = sq_p // block_q, skv_p // block_kv
+
+    grid = (b * n, nq, nk)
+
+    def q_idx(h, qi, ki):
+        return (h // n, h % n, qi, 0)
+
+    def kv_idx(h, qi, ki):
+        return (h // n, (h % n) // group, ki, 0)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        causal=causal,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_kv=block_kv,
+        kv_len=skv,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_idx, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM),
+            # trailing singleton keeps the block's last-two-dims tiling legal
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda h, qi, ki: (h // n, h % n, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, n, sq_p, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return o[:, :, :sq, :], lse[:, :, :sq, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, causal, sm_scale, block_q, block_kv, kv_len,
+):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start, kv_start = qi * block_q, ki * block_kv
+    run = True if not causal else kv_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        kv_pos = kv_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kv_pos < kv_len
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (kv_pos <= q_pos)
+        lse = lse_ref[0, 0, :, 0]  # (bq,)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        do = do_ref[0, 0].astype(jnp.float32)  # (bq, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        delta = delta_ref[0, 0, :, 0]  # (bq,)
+        ds = p * (dp - delta[:, None])  # (bq, bk)
+        dq_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, causal, sm_scale, block_q, block_kv, kv_len, q_len,
+):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start, kv_start = qi * block_q, ki * block_kv
+    run = True if not causal else q_start + block_q - 1 >= kv_start
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        kv_pos = kv_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        mask = (kv_pos < kv_len) & (q_pos < q_len)
+        if causal:
+            mask = mask & (kv_pos <= q_pos)
+        lse = lse_ref[0, 0, :, 0]
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # (bq, bk)
+        do = do_ref[0, 0].astype(jnp.float32)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = delta_ref[0, 0, :, 0]
+        ds = p * (dp - delta[:, None])
+        dk_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, q_ref[0, 0].astype(jnp.float32),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, D); q_ref re-read unscaled — the sm_scale prefactor covers it
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv):
+    b, n, sq, d = q.shape
+    nkv, skv = k.shape[1], k.shape[2]
+    group = n // nkv
+
+    delta = jnp.sum(
+        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+    )  # (B, N, Sq)
+
+    qp = _pad_to(q, block_q, 2)
+    dop = _pad_to(do, block_q, 2)
+    lsep = _pad_to(lse, block_q, 2)[..., None]    # (B, N, Sq_p, 1)
+    deltap = _pad_to(delta, block_q, 2)[..., None]
+    kp = _pad_to(k, block_kv, 2)
+    vp = _pad_to(v, block_kv, 2)
+    sq_p, skv_p = qp.shape[2], kp.shape[2]
+    nq_blk, nk_blk = sq_p // block_q, skv_p // block_kv
+
+    def q_idx(h, i, j):
+        return (h // n, h % n, i, 0)
+
+    def q_vec_idx(h, i, j):
+        return (h // n, h % n, i, 0)
+
+    def kv_idx(h, i, j):
+        return (h // n, (h % n) // group, j, 0)
+
+    # dq: grid (BN, nq, nk)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_kv=block_kv, kv_len=skv,
+        ),
+        grid=(b * n, nq_blk, nk_blk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), q_vec_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), q_vec_idx, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    # dk/dv: grid (BN, nk, nq) — per q-head, then group-summed for GQA
+    def kv_idx2(h, j, i):
+        return (h // n, (h % n) // group, j, 0)
+
+    def q_idx2(h, j, i):
+        return (h // n, h % n, i, 0)
+
+    def q_vec_idx2(h, j, i):
+        return (h // n, h % n, i, 0)
+
+    def dkv_idx(h, j, i):
+        return (h // n, h % n, j, 0)
+
+    dk_ph, dv_ph = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_kv=block_kv, kv_len=skv, q_len=sq,
+        ),
+        grid=(b * n, nk_blk, nq_blk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_idx2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_idx2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), kv_idx2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), q_idx2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), q_vec_idx2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), q_vec_idx2, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_kv, d), dkv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_kv, d), dkv_idx, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, skv_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, n, skv_p, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    # GQA: sum q-head contributions within each kv group
+    dk = dk_ph[:, :, :skv, :].reshape(b, nkv, group, skv, d).sum(axis=2)
+    dv = dv_ph[:, :, :skv, :].reshape(b, nkv, group, skv, d).sum(axis=2)
+    return dq[:, :, :sq, :], dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_bnsd(q, k, v, causal, sm_scale, block_q, block_kv):
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv)
+    return o
+
+
+def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_kv):
+    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv)
+    return o, (q, k, v, o, lse)
+
+
+def _bwd_rule(causal, sm_scale, block_q, block_kv, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd(
+        q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv
+    )
+    return dq, dk, dv
+
+
+_flash_attention_bnsd.defvjp(_fwd_rule, _bwd_rule)
+
+
+def pallas_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_kv: int = DEFAULT_BLOCK_KV,
+) -> jax.Array:
+    """(B, S, N, D) layout entry point matching
+    :func:`..kernels.flash_attention.flash_attention`."""
+    sm_scale = q.shape[-1] ** -0.5
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = _flash_attention_bnsd(
+        qt, kt, vt, causal, sm_scale, block_q, block_kv
+    )
+    return o.transpose(0, 2, 1, 3)

@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
@@ -80,14 +81,19 @@ class LlamaConfig:
     # use the Pallas flash-attention kernel for core attention (reference
     # nki_flash_attn_func opt-in, modeling_llama_nxd.py:410-417)
     use_flash_attention: bool = False
+    # chunk the LM head + CE over the sequence so full (B,S,V) logits never
+    # materialize; None disables (loss-memory redesign, no reference analogue)
+    loss_chunk_size: Optional[int] = None
 
     def __post_init__(self):
         if self.head_dim is None:
             object.__setattr__(self, "head_dim", self.hidden_size // self.num_heads)
         if self.num_heads % self.num_kv_heads != 0:
             raise ValueError("num_heads must be a multiple of num_kv_heads")
-        if self.remat not in ("none", "full", "selective"):
-            raise ValueError(f"remat must be none/full/selective, got {self.remat!r}")
+        if self.remat not in ("none", "full", "selective", "hybrid"):
+            raise ValueError(
+                f"remat must be none/full/selective/hybrid, got {self.remat!r}"
+            )
 
 
 # Published Llama-3.x architectures (HF config.json values).
@@ -288,6 +294,9 @@ class LlamaAttention:
         v = v.reshape(b, s, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, sin, cos, positions)
         k = apply_rope(k, sin, cos, positions)
+        q = checkpoint_name(q, "qkv_rope")
+        k = checkpoint_name(k, "qkv_rope")
+        v = checkpoint_name(v, "qkv_rope")
         if c.use_flash_attention:
             from neuronx_distributed_llama3_2_tpu.kernels.flash_attention import (
                 flash_attention,
@@ -295,6 +304,7 @@ class LlamaAttention:
             attn = flash_attention(q, k, v, causal=True)
         else:
             attn = core_attention(q, k, v, causal=True)
+        attn = checkpoint_name(attn, "attn_out")
         attn = attn.reshape(b, s, c.num_heads * c.head_dim)
         return self._o()(params["o"], attn)
 
@@ -381,6 +391,14 @@ def _remat_policy(remat: str):
         return None
     if remat == "full":
         return jax.checkpoint_policies.nothing_saveable
+    if remat == "hybrid":
+        # save only H-wide tensors that are expensive to recompute (post-RoPE
+        # q/k/v and the attention output); recompute norms and the 8x-wide
+        # MLP intermediates. Best memory/recompute tradeoff for large-vocab
+        # llama on 16G chips.
+        return jax.checkpoint_policies.save_only_these_names(
+            "qkv_rope", "attn_out"
+        )
     # "selective": save the big matmul outputs, recompute the rest (attention
     # scores/softmax, norms) — the analogue of the reference checkpointing
     # CoreAttention (modeling_llama_nxd.py:214 + run_llama_nxd.py:117)
@@ -497,8 +515,20 @@ class LlamaForCausalLM:
     ) -> jax.Array:
         """Shared LM-head + masked-mean CE tail (used by the pipelined model
         too, so masking semantics can never diverge)."""
-        logits = self._logits(params, hidden[:, :-1, :])
         shifted = labels[:, 1:]
+        if self.config.loss_chunk_size is not None:
+            from neuronx_distributed_llama3_2_tpu.parallel.loss import (
+                fused_linear_cross_entropy,
+            )
+
+            loss_sum, count = fused_linear_cross_entropy(
+                hidden[:, :-1, :],
+                lambda hc: self._logits(params, hc),
+                shifted,
+                chunk_size=self.config.loss_chunk_size,
+            )
+            return loss_sum / jnp.maximum(count, 1.0)
+        logits = self._logits(params, hidden[:, :-1, :])
         per_tok = parallel_cross_entropy(logits, shifted)
         # same validity mask as the CE kernel, so the denominator never counts
         # tokens whose numerator was zeroed (ignore-index or out-of-vocab ids)

@@ -110,6 +110,50 @@ def parallel_cross_entropy(
     return f(logits, labels)
 
 
+def fused_linear_cross_entropy(
+    hidden: jax.Array,
+    logits_fn,
+    labels: jax.Array,
+    chunk_size: int = 512,
+    label_smoothing: float = 0.0,
+):
+    """Sum of per-token CE + valid-token count, computing the LM head in
+    sequence chunks so the (B, T, V) logits never materialize (neither fp32
+    nor bf16) — the memory wall of large-vocab models. Each chunk is
+    ``jax.checkpoint``-ed: backward recomputes its logits instead of storing
+    them. Vocab-parallel semantics are inherited from
+    :func:`parallel_cross_entropy`.
+
+    ``hidden`` (B, T, H); ``logits_fn(h_chunk) -> (B, c, V)``; ``labels``
+    (B, T). Returns (loss_sum, valid_count), both f32 scalars. (The reference
+    has no analogue — its lm head always materializes full logits,
+    modeling_llama_nxd.py:643; this is a TPU-memory-driven redesign.)
+    """
+    b, t, h = hidden.shape
+    pad = -t % chunk_size
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)), constant_values=-1)
+    nc = hidden.shape[1] // chunk_size
+    h_chunks = hidden.reshape(b, nc, chunk_size, h).swapaxes(0, 1)
+    l_chunks = labels.reshape(b, nc, chunk_size).swapaxes(0, 1)
+
+    def body(carry, chunk):
+        hc, lc = chunk
+        logits = logits_fn(hc)
+        per_tok = parallel_cross_entropy(logits, lc, label_smoothing)
+        valid = (lc >= 0) & (lc < logits.shape[-1])
+        s = jnp.sum(per_tok * valid.astype(jnp.float32))
+        n = jnp.sum(valid.astype(jnp.float32))
+        return (carry[0] + s, carry[1] + n), None
+
+    body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    (loss_sum, count), _ = jax.lax.scan(
+        body, (jnp.float32(0), jnp.float32(0)), (h_chunks, l_chunks)
+    )
+    return loss_sum, count
+
+
 def cross_entropy(
     logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
 ) -> jax.Array:

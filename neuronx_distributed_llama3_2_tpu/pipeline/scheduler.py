@@ -89,19 +89,31 @@ class PipeSchedule:
     def flat_tasks(self) -> List[PipelineTask]:
         return [t for step in self.steps() for t in step]
 
+    def _fwd_tasks(self, mb: int) -> List[PipelineTask]:
+        tasks: List[PipelineTask] = []
+        if not self.is_first:
+            tasks.append(RecvForwardTask(mb))
+        tasks.append(ForwardStepTask(mb))
+        if not self.is_last:
+            tasks.append(SendForwardTask(mb))
+        return tasks
+
+    def _bwd_tasks(self, mb: int) -> List[PipelineTask]:
+        tasks: List[PipelineTask] = []
+        if not self.is_last:
+            tasks.append(RecvBackwardTask(mb))
+        tasks.append(BackwardStepTask(mb))
+        if not self.is_first:
+            tasks.append(SendBackwardTask(mb))
+        return tasks
+
 
 class InferenceSchedule(PipeSchedule):
     """Forward-only (reference scheduler.py:144)."""
 
     def steps(self):
         for mb in range(self.num_microbatches):
-            tasks: List[PipelineTask] = []
-            if not self.is_first:
-                tasks.append(RecvForwardTask(mb))
-            tasks.append(ForwardStepTask(mb))
-            if not self.is_last:
-                tasks.append(SendForwardTask(mb))
-            yield tasks
+            yield self._fwd_tasks(mb)
 
 
 class TrainGPipeSchedule(PipeSchedule):
@@ -111,21 +123,9 @@ class TrainGPipeSchedule(PipeSchedule):
 
     def steps(self):
         for mb in range(self.num_microbatches):
-            tasks: List[PipelineTask] = []
-            if not self.is_first:
-                tasks.append(RecvForwardTask(mb))
-            tasks.append(ForwardStepTask(mb))
-            if not self.is_last:
-                tasks.append(SendForwardTask(mb))
-            yield tasks
+            yield self._fwd_tasks(mb)
         for mb in range(self.num_microbatches):
-            tasks = []
-            if not self.is_last:
-                tasks.append(RecvBackwardTask(mb))
-            tasks.append(BackwardStepTask(mb))
-            if not self.is_first:
-                tasks.append(SendBackwardTask(mb))
-            yield tasks
+            yield self._bwd_tasks(mb)
         yield [ReduceGradsTask(-1)]
 
 
@@ -146,22 +146,18 @@ class Train1F1BSchedule(PipeSchedule):
         steady = n - warmup
         # warmup forwards
         for mb in range(warmup):
-            tasks: List[PipelineTask] = []
-            if not self.is_first:
-                tasks.append(RecvForwardTask(mb))
-            tasks.append(ForwardStepTask(mb))
-            if not self.is_last:
-                tasks.append(SendForwardTask(mb))
-            yield tasks
+            yield self._fwd_tasks(mb)
         # steady 1F1B: fwd mb = warmup + i, bwd mb = i
         for i in range(steady):
             fwd_mb = warmup + i
-            tasks = []
+            tasks: List[PipelineTask] = []
             if not self.is_first:
                 tasks.append(RecvForwardTask(fwd_mb))
             tasks.append(ForwardStepTask(fwd_mb))
             if not self.is_last:
-                # deadlock-avoidance order (reference scheduler.py:227-233)
+                # deadlock-avoidance order (reference scheduler.py:227-233):
+                # recv-bwd must precede send-fwd, so the steady block cannot
+                # reuse the plain _fwd_tasks/_bwd_tasks composition
                 tasks.append(RecvBackwardTask(i))
                 tasks.append(SendForwardTask(fwd_mb))
             tasks.append(BackwardStepTask(i))
@@ -170,11 +166,5 @@ class Train1F1BSchedule(PipeSchedule):
             yield tasks
         # cooldown backwards
         for mb in range(steady, n):
-            tasks = []
-            if not self.is_last:
-                tasks.append(RecvBackwardTask(mb))
-            tasks.append(BackwardStepTask(mb))
-            if not self.is_first:
-                tasks.append(SendBackwardTask(mb))
-            yield tasks
+            yield self._bwd_tasks(mb)
         yield [ReduceGradsTask(-1)]
