@@ -1,0 +1,57 @@
+"""Inference stack: KV-cache decode, bucketed AOT programs, sampling,
+continuous batching, speculative decoding.
+
+Role map to the reference (SURVEY.md §2.7):
+  model.py        ← examples/inference/modules/model_base.py (NeuronBaseModel)
+  engine.py       ← trace/model_builder.py + model_wrapper.py + autobucketing.py
+                    + NeuronBaseForCausalLM routing/_sample
+  sampling.py     ← src/neuronx_distributed/utils/sampling.py
+  speculative.py  ← src/neuronx_distributed/utils/speculative_decoding.py
+  benchmark.py    ← examples/inference/modules/benchmark.py
+  runner.py       ← examples/inference/runner.py
+"""
+
+from neuronx_distributed_llama3_2_tpu.inference.benchmark import (
+    GenerationBenchmark,
+    LatencyCollector,
+)
+from neuronx_distributed_llama3_2_tpu.inference.engine import (
+    ContinuousBatchingEngine,
+    GenerateResult,
+    GenerationConfig,
+    InferenceEngine,
+    default_buckets,
+    pick_bucket,
+)
+from neuronx_distributed_llama3_2_tpu.inference.model import KVCache, LlamaDecode
+from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+    SamplingConfig,
+    sample,
+)
+from neuronx_distributed_llama3_2_tpu.inference.runner import (
+    benchmark_generation,
+    check_accuracy_logits,
+)
+from neuronx_distributed_llama3_2_tpu.inference.speculative import (
+    SpeculativeDecoder,
+    SpeculativeResult,
+)
+
+__all__ = [
+    "ContinuousBatchingEngine",
+    "GenerateResult",
+    "GenerationBenchmark",
+    "GenerationConfig",
+    "InferenceEngine",
+    "KVCache",
+    "LatencyCollector",
+    "LlamaDecode",
+    "SamplingConfig",
+    "SpeculativeDecoder",
+    "SpeculativeResult",
+    "benchmark_generation",
+    "check_accuracy_logits",
+    "default_buckets",
+    "pick_bucket",
+    "sample",
+]

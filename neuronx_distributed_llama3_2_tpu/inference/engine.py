@@ -1,0 +1,450 @@
+"""Inference engine: bucketed AOT-compiled programs + generate loop +
+continuous batching.
+
+TPU-native replacement for the reference's inference orchestration:
+
+- ``ModelBuilder`` (trace/model_builder.py:82) compiles context-encode /
+  token-gen / speculation NEFFs sharing one weight set. Here each mode is a
+  jit specialization of ``LlamaDecode.forward`` at a different static T;
+  "single weights, many programs" is just passing the same sharded params
+  pytree to every compiled function. Weight-layout optimization
+  (model_builder.py:466-526) dissolves: XLA:TPU picks layouts per program and
+  jit keeps params in their sharded layout.
+- ``autobucketing`` (examples/inference/modules/autobucketing.py:6-124):
+  powers-of-2 context buckets, router picks the smallest bucket that fits and
+  right-pads. The reference does this in TorchScript bucket kernels; here it
+  is host Python choosing which compiled program to dispatch.
+- ``NeuronBaseForCausalLM.forward`` shape routing (model_base.py:742,:803-879)
+  → :meth:`InferenceEngine.generate`.
+- continuous batching via seq_ids KV scatter (model_base.py:394-401) →
+  :class:`ContinuousBatchingEngine` slot scheduler.
+- on-device sampling fused into the decode program (utils/sampling.py:6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_llama3_2_tpu.inference.benchmark import (
+    GenerationBenchmark,
+)
+from neuronx_distributed_llama3_2_tpu.inference.model import KVCache, LlamaDecode
+from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+    SamplingConfig,
+    sample,
+)
+from neuronx_distributed_llama3_2_tpu.models.llama import LlamaConfig
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+def default_buckets(max_seq_len: int, min_bucket: int = 128) -> List[int]:
+    """Powers-of-2 bucket ladder up to max_seq_len (reference
+    autobucketing.py:6 generate_buckets)."""
+    buckets = []
+    b = min_bucket
+    while b < max_seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_seq_len)
+    return buckets
+
+
+def pick_bucket(buckets: Sequence[int], length: int) -> int:
+    """Smallest bucket >= length (reference context-encode bucket-from-extent,
+    autobucketing.py:62-124)."""
+    for b in buckets:
+        if b >= length:
+            return b
+    raise ValueError(f"length {length} exceeds largest bucket {buckets[-1]}")
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 128
+    eos_token_id: Optional[int] = None
+    sampling: SamplingConfig = SamplingConfig()
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class GenerateResult:
+    sequences: List[List[int]]      # new tokens only (no prompt), per request
+    benchmark: GenerationBenchmark
+
+
+class InferenceEngine:
+    """Owns the cache state + the table of AOT-compiled programs.
+
+    The cache lives as engine state and is *donated* through every call
+    (reference: KV cache as persistent device state allocated by
+    StateInitializer, trace/spmd.py:63; aliasing via io_aliases) — each step
+    updates it in place without reallocating HBM.
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: Any,
+        *,
+        max_batch: int = 4,
+        max_seq_len: int = 2048,
+        buckets: Optional[Sequence[int]] = None,
+        cache_dtype: Any = None,
+    ) -> None:
+        self.config = config
+        self.model = LlamaDecode(config)
+        self.params = params
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len
+        self.buckets = list(buckets) if buckets else default_buckets(max_seq_len)
+        if self.buckets[-1] > max_seq_len:
+            raise ValueError("largest bucket exceeds max_seq_len")
+        self.cache = self.model.init_cache(max_batch, max_seq_len, cache_dtype)
+        self._programs: Dict[Tuple, Callable] = {}
+
+    # -- program table ----------------------------------------------------
+
+    def _prefill_program(self, batch: int, bucket: int, cfg: SamplingConfig):
+        """Context-encode program: bucket-causal forward, last-valid-token
+        gather, LM head on that single position, on-device sample."""
+        key_ = ("prefill", batch, bucket, cfg)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model = self.model
+
+        def prefill(params, cache, ids, lengths, slots, key):
+            positions = jnp.zeros((ids.shape[0],), jnp.int32)
+            hidden, cache = model.forward(
+                params, cache, ids, positions, slots,
+                context_encode=True, return_hidden=True,
+            )
+            # last-token gather before the LM head (model_base.py:444-452)
+            last = jnp.take_along_axis(
+                hidden, (lengths - 1)[:, None, None], axis=1
+            )  # (b, 1, H)
+            logits = model._model()._logits(params, last)[:, 0, :]
+            tokens = sample(logits, key, cfg)
+            return tokens, logits, cache
+
+        fn = jax.jit(prefill, donate_argnums=(1,))
+        self._programs[key_] = fn
+        return fn
+
+    def _decode_program(self, batch: int, cfg: SamplingConfig):
+        """Token-gen program: T=1 forward + on-device sample."""
+        key_ = ("decode", batch, cfg)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model = self.model
+
+        def decode(params, cache, tokens, positions, slots, key):
+            logits, cache = model.forward(
+                params, cache, tokens[:, None], positions, slots
+            )
+            logits = logits[:, 0, :]
+            nxt = sample(logits, key, cfg)
+            return nxt, logits, cache
+
+        fn = jax.jit(decode, donate_argnums=(1,))
+        self._programs[key_] = fn
+        return fn
+
+    def _verify_program(self, batch: int, block: int):
+        """Speculation program: T=block forward returning full block logits
+        (reference speculation model, model_base.py:348-352)."""
+        key_ = ("verify", batch, block)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model = self.model
+
+        def verify(params, cache, tokens, positions, slots):
+            return model.forward(params, cache, tokens, positions, slots)
+
+        fn = jax.jit(verify, donate_argnums=(1,))
+        self._programs[key_] = fn
+        return fn
+
+    @staticmethod
+    def _abstract(tree):
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
+            tree,
+        )
+
+    def aot_compile(
+        self,
+        batch_sizes: Optional[Sequence[int]] = None,
+        sampling: SamplingConfig = SamplingConfig(),
+        speculative_blocks: Sequence[int] = (),
+    ) -> float:
+        """Eagerly compile every (bucket × batch) program via jit AOT
+        (``lower().compile()``) — the ModelBuilder compile() phase
+        (model_builder.py:130). Compiled executables replace the lazy jit
+        wrappers in the program table so the first request pays no compile.
+        Returns wall-clock compile seconds."""
+        t0 = time.perf_counter()
+        params_abs = self._abstract(self.params)
+        cache_abs = self._abstract(self.cache)
+        key_abs = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+        i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+        for b in batch_sizes or (self.max_batch,):
+            for bucket in self.buckets:
+                fn = self._prefill_program(b, bucket, sampling)
+                self._programs[("prefill", b, bucket, sampling)] = fn.lower(
+                    params_abs, cache_abs, i32(b, bucket), i32(b), i32(b),
+                    key_abs,
+                ).compile()
+            fn = self._decode_program(b, sampling)
+            self._programs[("decode", b, sampling)] = fn.lower(
+                params_abs, cache_abs, i32(b), i32(b), i32(b), key_abs
+            ).compile()
+            for block in speculative_blocks:
+                fn = self._verify_program(b, block)
+                self._programs[("verify", b, block)] = fn.lower(
+                    params_abs, cache_abs, i32(b, block), i32(b), i32(b)
+                ).compile()
+        return time.perf_counter() - t0
+
+    def prefill_batch(
+        self,
+        prompts: Sequence[Sequence[int]],
+        slots: Sequence[int],
+        sampling: SamplingConfig,
+        key: jax.Array,
+    ) -> np.ndarray:
+        """Context-encode a batch of prompts into the given cache slots:
+        route to the smallest fitting bucket, right-pad, run the prefill
+        program, return the first sampled token per row (host np array).
+
+        The single shared implementation of bucket-route + pad + prefill used
+        by generate(), continuous batching, and speculative decoding."""
+        b = len(prompts)
+        if b != len(slots):
+            raise ValueError("prompts and slots must have equal length")
+        max_len = max((len(p) for p in prompts), default=1)
+        if max_len > self.max_seq_len:
+            raise ValueError(
+                f"prompt length {max_len} exceeds max_seq_len {self.max_seq_len}"
+            )
+        bucket = pick_bucket(self.buckets, max_len)
+        ids = np.zeros((b, bucket), np.int32)
+        lengths = np.ones((b,), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, : len(p)] = p
+            lengths[i] = max(len(p), 1)
+        fn = self._prefill_program(b, bucket, sampling)
+        tokens, _, self.cache = fn(
+            self.params,
+            self.cache,
+            jnp.asarray(ids),
+            jnp.asarray(lengths),
+            jnp.asarray(slots, dtype=jnp.int32),
+            key,
+        )
+        return np.asarray(jax.device_get(tokens))
+
+    # -- generate ---------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        gen: GenerationConfig = GenerationConfig(),
+    ) -> GenerateResult:
+        """Batch generate. Routes by shape to the right bucket program,
+        right-pads, then runs the token-gen loop with on-device sampling
+        (reference NeuronBaseForCausalLM.forward routing + _sample loop,
+        model_base.py:742,:1050)."""
+        nreq = len(prompts)
+        if nreq == 0 or nreq > self.max_batch:
+            raise ValueError(f"need 1..{self.max_batch} prompts, got {nreq}")
+        max_len = max(len(p) for p in prompts)
+        if max_len + gen.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({max_len}) + max_new_tokens ({gen.max_new_tokens}) "
+                f"exceeds max_seq_len ({self.max_seq_len})"
+            )
+        b = self.max_batch  # fixed program batch; pad requests
+        padded = list(prompts) + [[0]] * (b - nreq)
+        lengths = np.asarray([max(len(p), 1) for p in padded], np.int32)
+        slots = jnp.arange(b, dtype=jnp.int32)
+
+        bench = GenerationBenchmark()
+        key = jax.random.key(gen.seed)
+        decode = self._decode_program(b, gen.sampling)
+
+        t_start = time.perf_counter()
+        key, k0 = jax.random.split(key)
+        with bench.ttft.timed():
+            tokens_host = self.prefill_batch(padded, np.arange(b), gen.sampling, k0)
+        tokens = jnp.asarray(tokens_host)
+
+        out: List[List[int]] = [[int(tokens_host[i])] for i in range(nreq)]
+        done = [
+            gen.eos_token_id is not None and out[i][-1] == gen.eos_token_id
+            for i in range(nreq)
+        ]
+        positions = jnp.asarray(lengths)  # next write position = prompt length
+
+        for _ in range(gen.max_new_tokens - 1):
+            if all(done):
+                break
+            key, kd = jax.random.split(key)
+            with bench.per_token.timed():
+                tokens, _, self.cache = decode(
+                    self.params, self.cache, tokens, positions, slots, kd
+                )
+                tokens_host = np.asarray(jax.device_get(tokens))
+            positions = positions + 1
+            for i in range(nreq):
+                if not done[i]:
+                    out[i].append(int(tokens_host[i]))
+                    if (
+                        gen.eos_token_id is not None
+                        and out[i][-1] == gen.eos_token_id
+                    ):
+                        done[i] = True
+        bench.e2e.record(time.perf_counter() - t_start)
+        return GenerateResult(sequences=out, benchmark=bench)
+
+    def prefill_logits(self, input_ids: jax.Array) -> jax.Array:
+        """Full (B, S, V) prefill logits — the logit-accuracy gate input
+        (reference check_accuracy_logits, examples/inference/runner.py:295).
+        Runs outside the donated-cache path (cache untouched)."""
+        b, s = input_ids.shape
+        cache = self.model.init_cache(b, s)
+        positions = jnp.zeros((b,), jnp.int32)
+        logits, _ = jax.jit(
+            lambda p, c, i, pos: self.model.forward(
+                p, c, i, pos, context_encode=True
+            )
+        )(self.params, cache, input_ids, positions)
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt: List[int]
+    out: List[int]
+    slot: Optional[int] = None
+    position: int = 0
+    done: bool = False
+
+
+class ContinuousBatchingEngine:
+    """Slot-scheduled serving loop over a shared KV cache.
+
+    The reference implements continuous batching as seq_ids-scatter KV
+    updates inside the compiled model (model_base.py:394-401) driven by an
+    external server. Here the engine owns the whole loop: requests are
+    admitted into free cache rows (slots) via a B=1 prefill program (scatter
+    at the slot), and one batched T=1 decode program advances every active
+    slot per step — finished slots are freed and refilled without stalling
+    the others.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        gen: GenerationConfig = GenerationConfig(),
+    ) -> None:
+        self.engine = engine
+        self.gen = gen
+        self._next_rid = 0
+        self._queue: List[_Request] = []
+        self._active: Dict[int, _Request] = {}  # slot -> request
+        self._finished: Dict[int, _Request] = {}
+        self._free_slots = list(range(engine.max_batch))
+        self._key = jax.random.key(gen.seed)
+        # per-slot decode state mirrored on host
+        self._tokens = np.zeros((engine.max_batch,), np.int32)
+        self._positions = np.zeros((engine.max_batch,), np.int32)
+
+    def submit(self, prompt: Sequence[int]) -> int:
+        if len(prompt) + self.gen.max_new_tokens > self.engine.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({self.gen.max_new_tokens}) exceeds cache capacity "
+                f"({self.engine.max_seq_len})"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid=rid, prompt=list(prompt), out=[]))
+        return rid
+
+    def _admit(self) -> None:
+        eng = self.engine
+        while self._queue and self._free_slots:
+            req = self._queue.pop(0)
+            slot = self._free_slots.pop(0)
+            req.slot = slot
+            self._key, k = jax.random.split(self._key)
+            first = int(
+                eng.prefill_batch([req.prompt], [slot], self.gen.sampling, k)[0]
+            )
+            req.out.append(first)
+            req.position = len(req.prompt)
+            self._tokens[slot] = first
+            self._positions[slot] = req.position
+            self._active[slot] = req
+            self._maybe_finish(req)
+
+    def _maybe_finish(self, req: _Request) -> None:
+        eos = self.gen.eos_token_id
+        if (
+            req.done  # e.g. cache-capacity cap set in step()
+            or (eos is not None and req.out and req.out[-1] == eos)
+            or len(req.out) >= self.gen.max_new_tokens
+        ):
+            req.done = True
+            if req.slot is not None:
+                del self._active[req.slot]
+                self._free_slots.append(req.slot)
+                req.slot = None
+            self._finished[req.rid] = req
+
+    def step(self) -> bool:
+        """Admit waiting requests, advance every active slot one token.
+        Returns False when nothing is left to do."""
+        self._admit()
+        if not self._active:
+            return bool(self._queue)
+        eng = self.engine
+        b = eng.max_batch
+        decode = eng._decode_program(b, self.gen.sampling)
+        self._key, k = jax.random.split(self._key)
+        toks, _, eng.cache = decode(
+            eng.params,
+            eng.cache,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._positions),
+            jnp.arange(b, dtype=jnp.int32),
+            k,
+        )
+        toks = np.asarray(jax.device_get(toks))
+        for slot, req in list(self._active.items()):
+            req.out.append(int(toks[slot]))
+            req.position += 1
+            self._tokens[slot] = toks[slot]
+            self._positions[slot] = req.position
+            if req.position >= eng.max_seq_len - 1:
+                req.done = True
+            self._maybe_finish(req)
+        return bool(self._active or self._queue)
+
+    def run_to_completion(self) -> Dict[int, List[int]]:
+        while self.step():
+            pass
+        return {rid: r.out for rid, r in sorted(self._finished.items())}

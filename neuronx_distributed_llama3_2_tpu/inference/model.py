@@ -1,0 +1,260 @@
+"""KV-cache decoder model.
+
+TPU-native replacement for the reference's inference decoder
+(``examples/inference/modules/model_base.py``): ``NeuronBaseModel`` keeps the
+KV cache as per-layer ``nn.ParameterList`` state inside the traced NEFF
+(:52,:114-125), distinguishes context-encoding vs token-gen vs speculation by
+input length (:334,:348-352), scatters new K/V by position_ids or — under
+continuous batching — by seq_ids (:389-419), and gathers the last token before
+the LM head (:444-452).
+
+The TPU-first redesign collapses those three forward modes into ONE function::
+
+    forward(params, cache, tokens (b, T), positions (b,), slots (b,))
+
+- context-encode  = T == bucket,  positions == 0
+- token-gen       = T == 1
+- speculation     = T == gamma+1 (draft-verify block)
+
+because with scatter-writes into the cache and the mask ``j <= position + t``,
+block-causal decode *is* prefill when position == 0. Each static T compiles to
+its own XLA program sharing the same weight arrays — the reference needs a
+multi-model ModelBuilder (trace/model_builder.py:82) + shape router
+(trace/spmd.py:152) to get the same effect; here it is just multiple jit
+specializations of one function.
+
+The cache is a donated pytree of global arrays sharded over the mesh
+(kv-head dim over tp) — the reference's ``StateInitializer`` per-rank state
+alloc (trace/spmd.py:63) dissolves into PartitionSpecs.
+
+``slots`` is the reference's continuous-batching ``seq_ids`` scatter
+(model_base.py:394-401): requests live in cache rows ("slots") and a batch of
+b <= B active requests addresses its rows explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    RMSNorm,
+    _head_axis,
+    apply_rope,
+    precompute_rope,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+    BATCH_AXES,
+    constrain,
+)
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Stacked-layer KV cache: k/v (L, B, S_max, n_kv, head_dim)."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def max_batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaDecode:
+    """Decode-mode Llama sharing the training model's parameter pytree.
+
+    Construction mirrors the reference's DecoderModelInstance (the same
+    checkpoint drives both the training and the inference model,
+    model_wrapper.py:303); here they are literally the same arrays.
+    """
+
+    config: LlamaConfig
+
+    def _model(self) -> LlamaForCausalLM:
+        return LlamaForCausalLM(self.config)
+
+    # -- cache ------------------------------------------------------------
+
+    def init_cache(
+        self, max_batch: int, max_len: int, dtype: Any = None
+    ) -> KVCache:
+        c = self.config
+        dtype = dtype or c.dtype
+        shape = (c.num_layers, max_batch, max_len, c.num_kv_heads, c.head_dim)
+        return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    def cache_specs(self, max_batch: Optional[int] = None) -> KVCache:
+        """Cache sharding: batch over dp axes, kv heads over tp when
+        divisible (the decode analogue of the training GQA sharding rule,
+        parallel/layers.py GQAQKVColumnParallelLinear). Pass ``max_batch`` to
+        drop batch sharding when it doesn't divide the dp size (serving
+        batches are small; replication is the correct fallback)."""
+        from neuronx_distributed_llama3_2_tpu.parallel import (
+            state as parallel_state,
+        )
+
+        ha = _head_axis(self.config.num_kv_heads)
+        batch_axes: Any = BATCH_AXES
+        if max_batch is not None and parallel_state.model_parallel_is_initialized():
+            dp_total = parallel_state.get_parallel_state().data_parallel_size
+            if max_batch % dp_total != 0:
+                batch_axes = None
+        spec = P(None, batch_axes, None, ha, None)
+        return KVCache(k=spec, v=spec)
+
+    # -- forward ----------------------------------------------------------
+
+    def forward(
+        self,
+        params: Params,
+        cache: KVCache,
+        tokens: jax.Array,      # (b, T) int32
+        positions: jax.Array,   # (b,)  int32 — absolute start position
+        slots: Optional[jax.Array] = None,  # (b,) int32 cache rows; None = arange
+        *,
+        context_encode: bool = False,
+        return_hidden: bool = False,
+    ) -> Tuple[jax.Array, KVCache]:
+        """Block-causal forward over the cache.
+
+        Returns (logits (b, T, V), updated cache). ``context_encode=True``
+        asserts positions == 0 and computes attention only over the fresh
+        block (bucket-causal, no cache read) — the fast prefill path; the
+        general path attends over the whole cache with the mask
+        ``j <= position + t``.
+        """
+        c = self.config
+        model = self._model()
+        b, t = tokens.shape
+        if slots is None:
+            slots = jnp.arange(b, dtype=jnp.int32)
+
+        pos_block = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        sin, cos = precompute_rope(
+            c.head_dim, cache.max_len, c.rope_theta, c.rope_scaling
+        )
+
+        x = model._embed()(params["embed"], tokens)
+        x = constrain(x, P(BATCH_AXES, None, None))
+        norm = RMSNorm(c.hidden_size, c.rms_norm_eps, c.dtype)
+
+        def layer_body(x, layer_in):
+            lp, kc, vc = layer_in
+            x, kc, vc = self._decode_layer(
+                lp, x, kc, vc, sin, cos, pos_block, positions, slots,
+                context_encode=context_encode,
+            )
+            return x, (kc, vc)
+
+        if c.scan_layers:
+            x, (k_new, v_new) = jax.lax.scan(
+                layer_body, x, (params["layers"], cache.k, cache.v)
+            )
+        else:
+            ks, vs = [], []
+            for i in range(c.num_layers):
+                lp = jax.tree.map(lambda p: p[i], params["layers"])
+                x, (kc, vc) = layer_body(x, (lp, cache.k[i], cache.v[i]))
+                ks.append(kc)
+                vs.append(vc)
+            k_new, v_new = jnp.stack(ks), jnp.stack(vs)
+
+        x = norm(params["final_norm"], x)
+        new_cache = KVCache(k=k_new, v=v_new)
+        if return_hidden:
+            return x, new_cache
+        logits = model._logits(params, x)
+        return logits, new_cache
+
+    def _decode_layer(
+        self, lp, x, kc, vc, sin, cos, pos_block, positions, slots,
+        *, context_encode: bool,
+    ):
+        """One decoder layer with cache read/write.
+
+        kc/vc: (B, S_max, NKV, D) full cache rows for this layer;
+        x: (b, T, H). Writes fresh K/V at (slots, pos_block) then attends.
+        """
+        c = self.config
+        from neuronx_distributed_llama3_2_tpu.models.llama import (
+            LlamaAttention,
+            LlamaMLP,
+        )
+
+        attn = LlamaAttention(c)
+        norm = RMSNorm(c.hidden_size, c.rms_norm_eps, c.dtype)
+        b, t, _ = x.shape
+
+        h = norm(lp["attn_norm"], x)
+        q, k, v = attn._qkv()(lp["attn"]["qkv"], h)
+        q = q.reshape(b, t, c.num_heads, c.head_dim)
+        k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
+        v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, sin, cos, pos_block)
+        k = apply_rope(k, sin, cos, pos_block)
+
+        # scatter-write the fresh block into the cache at (slot, position) —
+        # the reference's position_ids/seq_ids KV scatter (model_base.py:389-419);
+        # writes cast to the cache dtype so cache_dtype survives and donation
+        # can reuse the buffers
+        kc = kc.at[slots[:, None], pos_block].set(k.astype(kc.dtype))
+        vc = vc.at[slots[:, None], pos_block].set(v.astype(vc.dtype))
+
+        ha = _head_axis(c.num_heads)
+        if context_encode:
+            # bucket-causal over the fresh block only (reference
+            # context-encoding path, model_base.py:348-352) — exactly the
+            # training model's core attention, shared so the decode model can
+            # never diverge numerically from the trained one
+            from neuronx_distributed_llama3_2_tpu.models.llama import (
+                core_attention,
+            )
+
+            att = core_attention(q, k, v, causal=True)
+        else:
+            # attend over the cache rows of the active slots
+            k_all = jnp.take(kc, slots, axis=0).astype(q.dtype)  # (b,S_max,NKV,D)
+            v_all = jnp.take(vc, slots, axis=0).astype(q.dtype)
+            att = self._cache_attention(q, k_all, v_all, pos_block, ha)
+
+        att = att.reshape(b, t, c.num_heads * c.head_dim)
+        x = x + attn._o()(lp["attn"]["o"], att)
+        h = norm(lp["mlp_norm"], x)
+        x = x + LlamaMLP(c)(lp["mlp"], h)
+        return x, kc, vc
+
+    def _cache_attention(self, q, k_all, v_all, pos_block, ha):
+        """q (b,T,N,D) against full cache rows (b,S_max,NKV,D) with the mask
+        ``cache_index <= position + t`` (block-causal across the fresh block,
+        full visibility of the committed prefix; garbage rows beyond the
+        write frontier are masked out — reference manual prior+active softmax
+        combine, attention_base.py:141-167, done here as one masked softmax)."""
+        b, t, n, d = q.shape
+        s_max = k_all.shape[1]
+        nkv = k_all.shape[2]
+        if nkv != n:
+            rep = n // nkv
+            k_all = jnp.repeat(k_all, rep, axis=2)
+            v_all = jnp.repeat(v_all, rep, axis=2)
+        scores = jnp.einsum("bsnd,btnd->bnst", q, k_all) * (d ** -0.5)
+        scores = constrain(scores, P(BATCH_AXES, ha, None, None))
+        scores = scores.astype(jnp.float32)
+        j = jax.lax.iota(jnp.int32, s_max)[None, None, :]  # (1,1,S_max)
+        mask = j <= pos_block[:, :, None]  # (b,T,S_max)
+        scores = jnp.where(mask[:, None, :, :], scores, jnp.float32(-1e30))
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bnst,btnd->bsnd", probs, v_all)
+        return constrain(out, P(BATCH_AXES, None, ha, None))

@@ -1,0 +1,110 @@
+"""Inference runner: accuracy gate + latency benchmark.
+
+TPU-native port of the reference's ``InferenceRunner``
+(``examples/inference/runner.py:36``): ``check_accuracy_logits`` (:295-409)
+compares the compiled decode model's logits against a CPU reference
+(HF transformers when available, else our own un-jitted fp32 forward), and
+``benchmark_generation`` produces the p50/p90/p99 TTFT + per-token latency
+report (examples/inference/modules/benchmark.py:9-66).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_llama3_2_tpu.inference.engine import (
+    GenerationConfig,
+    InferenceEngine,
+)
+from neuronx_distributed_llama3_2_tpu.inference.sampling import SamplingConfig
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+def check_accuracy_logits(
+    engine: InferenceEngine,
+    input_ids: np.ndarray,
+    ref_logits: Optional[np.ndarray] = None,
+    atol: float = 1e-3,
+) -> Dict[str, float]:
+    """Logit-accuracy gate (reference runner.py:295-409): prefill logits vs a
+    CPU reference. ``ref_logits`` defaults to our own fp32 forward — callers
+    with an HF model pass its logits instead. Raises on gate failure."""
+    ids = jnp.asarray(input_ids, jnp.int32)
+    got = np.asarray(engine.prefill_logits(ids), np.float32)
+    if ref_logits is None:
+        import dataclasses
+
+        fp32_cfg = dataclasses.replace(engine.config, dtype=jnp.float32)
+        ref_logits = np.asarray(
+            jax.jit(LlamaForCausalLM(fp32_cfg).__call__)(engine.params, ids),
+            np.float32,
+        )
+    err = np.abs(got - ref_logits)
+    report = {
+        "max_abs_err": float(err.max()),
+        "mean_abs_err": float(err.mean()),
+        "top1_agreement": float(
+            (got.argmax(-1) == ref_logits.argmax(-1)).mean()
+        ),
+    }
+    if report["max_abs_err"] > atol:
+        raise AssertionError(f"logit accuracy gate failed: {report} (atol={atol})")
+    logger.info("logit accuracy gate passed: %s", report)
+    return report
+
+
+def benchmark_generation(
+    engine: InferenceEngine,
+    prompt_len: int = 128,
+    max_new_tokens: int = 64,
+    n_runs: int = 5,
+    warmup: int = 1,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """p50/p90/p99 TTFT + per-token latency over ``n_runs`` generate() calls
+    (reference Benchmark over 20 runs, benchmark.py:9; TTFT = prefill +
+    first-token sample)."""
+    rng = np.random.default_rng(seed)
+    gen = GenerationConfig(
+        max_new_tokens=max_new_tokens, sampling=SamplingConfig(greedy=True)
+    )
+    reports: List[Dict] = []
+    tok_rates: List[float] = []
+    for run in range(warmup + n_runs):
+        prompts = [
+            rng.integers(0, engine.config.vocab_size, size=(prompt_len,)).tolist()
+            for _ in range(engine.max_batch)
+        ]
+        t0 = time.perf_counter()
+        res = engine.generate(prompts, gen)
+        dt = time.perf_counter() - t0
+        if run < warmup:
+            continue
+        n_tok = sum(len(s) for s in res.sequences)
+        tok_rates.append(n_tok / dt)
+        reports.append(res.benchmark.report())
+
+    def pctl(key: str, sub: str) -> float:
+        return float(np.median([r[key][sub] for r in reports]))
+
+    return {
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "batch": engine.max_batch,
+        "ttft_p50_ms": pctl("ttft", "p50_ms"),
+        "per_token_p50_ms": pctl("per_token", "p50_ms"),
+        "per_token_p90_ms": pctl("per_token", "p90_ms"),
+        "per_token_p99_ms": pctl("per_token", "p99_ms"),
+        "tokens_per_s": float(np.median(tok_rates)),
+    }

@@ -1,0 +1,58 @@
+"""On-device sampling.
+
+TPU-native replacement for the reference's ``Sampler``
+(``src/neuronx_distributed/utils/sampling.py:6``), which builds on-device
+greedy argmax / top-k multinomial via custom Neuron TopK/Softmax/Argmax calls.
+On TPU these are plain jax ops (``lax.top_k``, ``jax.random.categorical``) —
+no custom calls needed; everything here jit-fuses into the decode program so
+logits never leave the device (reference on_device_sampling config,
+examples/inference/modules/config.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Static sampling parameters (compiled into the decode program)."""
+
+    greedy: bool = True
+    temperature: float = 1.0
+    top_k: int = 0       # 0 = disabled
+    top_p: float = 1.0   # 1.0 = disabled
+
+    def __post_init__(self):
+        if self.temperature <= 0.0:
+            raise ValueError("temperature must be > 0; use greedy=True for argmax")
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+
+
+def sample(
+    logits: jax.Array, key: jax.Array, config: SamplingConfig
+) -> jax.Array:
+    """Sample token ids from (..., V) logits. Returns (...,) int32."""
+    if config.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / config.temperature
+    if config.top_k > 0:
+        k = min(config.top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if config.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the minimal prefix whose mass reaches top_p: a token is kept
+        # if the cumulative mass *before* it is < top_p
+        keep = (cum - probs) < config.top_p
+        cutoff = jnp.max(jnp.where(keep, sorted_logits, -jnp.inf), axis=-1)
+        logits = jnp.where(logits < cutoff[..., None], -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,158 @@
+"""Draft-model speculative decoding.
+
+TPU-native port of the reference's ``NeuronSpeculation`` draft-assisted
+greedy decode (``src/neuronx_distributed/utils/speculative_decoding.py:15``,
+greedy flow :40): a small draft model proposes ``gamma`` tokens
+autoregressively; the target model scores the whole block in ONE forward (the
+"speculation" program, model_base.py:348-352) and the longest prefix agreeing
+with the target's greedy choice is accepted, plus one bonus/correction token.
+
+Cache bookkeeping is the standard overwrite-frontier trick: rejected rows
+beyond the accepted frontier are simply overwritten by the next round's
+scatter-writes — the block-causal mask ``j <= position + t`` never looks past
+the frontier, so no rollback copy is needed (the reference must copy KV
+between its context/speculation model wrappers, model_base.py:881).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_llama3_2_tpu.inference.engine import (
+    InferenceEngine,
+    pick_bucket,
+)
+from neuronx_distributed_llama3_2_tpu.inference.sampling import SamplingConfig
+
+
+@dataclasses.dataclass
+class SpeculativeResult:
+    tokens: List[int]
+    accepted_per_round: List[int]  # acceptance telemetry
+
+    @property
+    def mean_accepted(self) -> float:
+        if not self.accepted_per_round:
+            return 0.0
+        return sum(self.accepted_per_round) / len(self.accepted_per_round)
+
+
+class SpeculativeDecoder:
+    """Greedy speculative decode of a single sequence (reference greedy
+    assisted decode, speculative_decoding.py:40). Output is provably
+    identical to plain greedy decoding of the target model."""
+
+    def __init__(
+        self,
+        target: InferenceEngine,
+        draft: InferenceEngine,
+        gamma: int = 4,
+    ) -> None:
+        if gamma < 1:
+            raise ValueError("gamma must be >= 1")
+        self.target = target
+        self.draft = draft
+        self.gamma = gamma
+        self._greedy = SamplingConfig(greedy=True)
+
+    def _prefill(self, engine: InferenceEngine, prompt: Sequence[int]) -> int:
+        return int(
+            engine.prefill_batch([prompt], [0], self._greedy, jax.random.key(0))[0]
+        )
+
+    def generate(
+        self, prompt: Sequence[int], max_new_tokens: int, eos_token_id=None
+    ) -> SpeculativeResult:
+        target, draft, g = self.target, self.draft, self.gamma
+        # Upfront capacity check (matches InferenceEngine.generate): every
+        # verify round scatter-writes up to g+1 rows past the frontier, so the
+        # whole run must fit or wrong tokens would be silently accepted.
+        if len(prompt) + max_new_tokens + g + 1 > target.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"+ gamma+1 ({g + 1}) exceeds target cache capacity "
+                f"({target.max_seq_len})"
+            )
+        if len(prompt) + max_new_tokens + g + 1 > draft.max_seq_len:
+            raise ValueError(
+                f"speculation run exceeds draft cache capacity "
+                f"({draft.max_seq_len})"
+            )
+        slot = jnp.asarray([0], jnp.int32)
+        decode_d = draft._decode_program(1, self._greedy)
+        verify_t = target._verify_program(1, g + 1)
+
+        t0 = self._prefill(target, prompt)
+        self._prefill(draft, prompt)
+
+        out: List[int] = [t0]
+        accepted_log: List[int] = []
+        # `cur` = newest emitted token, not yet written to either cache;
+        # `pos` = its write position (= number of committed cache rows).
+        cur = t0
+        pos = len(prompt)
+        key = jax.random.key(0)
+
+        while len(out) < max_new_tokens:
+            if eos_token_id is not None and out[-1] == eos_token_id:
+                break
+            # 1) draft proposes gamma tokens autoregressively
+            drafts: List[int] = []
+            dtok, dpos = cur, pos
+            for _ in range(g):
+                key, kd = jax.random.split(key)
+                t, _, draft.cache = decode_d(
+                    draft.params, draft.cache,
+                    jnp.asarray([dtok], jnp.int32),
+                    jnp.asarray([dpos], jnp.int32), slot, kd,
+                )
+                dtok = int(np.asarray(jax.device_get(t))[0])
+                drafts.append(dtok)
+                dpos += 1
+
+            # 2) target scores [cur, d_0..d_{g-1}] in one forward
+            block = jnp.asarray([[cur] + drafts], jnp.int32)
+            logits, target.cache = verify_t(
+                target.params, target.cache, block,
+                jnp.asarray([pos], jnp.int32), slot,
+            )
+            greedy = np.asarray(
+                jax.device_get(jnp.argmax(logits[0], axis=-1))
+            )  # greedy[i] = target's token for position pos+i+1
+
+            # 3) accept longest agreeing prefix + one correction/bonus token
+            a = 0
+            while a < g and drafts[a] == int(greedy[a]):
+                a += 1
+            emitted = drafts[:a] + [int(greedy[a])]
+            accepted_log.append(a)
+            if a == g:
+                # full acceptance: the draft loop wrote rows pos..pos+g-1
+                # ([cur, d_0..d_{g-2}]) but never committed d_{g-1}'s K/V at
+                # row pos+g, which the next round's mask will admit. Run one
+                # throwaway draft decode to commit it (output ignored).
+                key, kd = jax.random.split(key)
+                _, _, draft.cache = decode_d(
+                    draft.params, draft.cache,
+                    jnp.asarray([drafts[-1]], jnp.int32),
+                    jnp.asarray([pos + g], jnp.int32), slot, kd,
+                )
+            for tok in emitted:
+                out.append(tok)
+                if eos_token_id is not None and tok == eos_token_id:
+                    break
+                if len(out) >= max_new_tokens:
+                    break
+            cur = out[-1]
+            pos = pos + a + 1
+            if pos + g + 1 >= target.max_seq_len:
+                break
+
+        return SpeculativeResult(
+            tokens=out[:max_new_tokens], accepted_per_round=accepted_log
+        )
