@@ -3,3 +3,8 @@ from neuronx_distributed_llama3_2_tpu.models.llama import (  # noqa: F401
     LlamaForCausalLM,
     LLAMA_CONFIGS,
 )
+from neuronx_distributed_llama3_2_tpu.models.mixtral import (  # noqa: F401
+    MIXTRAL_CONFIGS,
+    MixtralConfig,
+    MixtralForCausalLM,
+)

@@ -1,0 +1,230 @@
+"""Mixtral-style MoE causal LM.
+
+TPU-native counterpart of the reference's MoE pretraining model
+(``examples/training/mixtral/modeling_mixtral_moe_nxd.py``, 889 LoC, which
+wires ``MoE(RouterTopK, ExpertMLPs)`` into HF Mixtral) and the Mixtral
+inference model (``examples/inference/mixtral/neuron_modeling_mixtral.py``).
+Reuses the Llama attention/norm blocks (Mixtral's attention IS Llama GQA
+attention) and swaps the dense MLP for the :class:`..moe.MoE` block; the
+per-layer router logits feed the Switch load-balancing loss
+(``modules/moe/loss_function.py:5``) accumulated across the scanned layers.
+
+Implements the same model protocol as :class:`.llama.LlamaForCausalLM`
+(init/specs/__call__/loss/loss_from_hidden), so the trainer and checkpoint
+layers work unchanged. The pipeline executor does NOT support MoE yet
+(:class:`..pipeline.PipelinedCausalLM` scans a plain hidden-state carry and
+its loss path would drop the router aux loss); it rejects MoE models
+explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LlamaAttention,
+    LlamaConfig,
+    LlamaForCausalLM,
+    RMSNorm,
+    _remat_policy,
+    precompute_rope,
+)
+from neuronx_distributed_llama3_2_tpu.moe.loss import load_balancing_loss
+from neuronx_distributed_llama3_2_tpu.moe.model import MoE, MoEConfig
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.layers import BATCH_AXES, constrain
+from neuronx_distributed_llama3_2_tpu.parallel.state import TP_AXIS
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    """LlamaConfig + MoE knobs (HF MixtralConfig fields)."""
+
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: Optional[float] = None
+    routing: str = "topk"
+    normalize_top_k: bool = True
+    router_aux_loss_coef: float = 0.02
+
+    def moe_config(self) -> MoEConfig:
+        return MoEConfig(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_experts=self.num_experts,
+            top_k=self.top_k,
+            capacity_factor=self.capacity_factor,
+            routing=self.routing,
+            normalize_top_k=self.normalize_top_k,
+            dtype=self.dtype,
+        )
+
+
+MIXTRAL_CONFIGS: Dict[str, MixtralConfig] = {
+    # HF mistralai/Mixtral-8x7B config.json values; capacity_factor sized for
+    # no dropping at balance (E/k = 4) with headroom — required for ep > 1
+    "mixtral-8x7b": MixtralConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        max_seq_len=32768, rope_theta=1e6, tie_word_embeddings=False,
+        num_experts=8, top_k=2, capacity_factor=4.0,
+    ),
+    "tiny-moe": MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=8, num_kv_heads=4, head_dim=8,
+        max_seq_len=128, rope_theta=10000.0, dtype=jnp.float32,
+        remat="none", num_experts=4, top_k=2,
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralDecoderLayer:
+    config: MixtralConfig
+
+    def _norm(self) -> RMSNorm:
+        c = self.config
+        return RMSNorm(c.hidden_size, c.rms_norm_eps, c.dtype)
+
+    def _moe(self) -> MoE:
+        return MoE(self.config.moe_config())
+
+    def init(self, key: jax.Array) -> Params:
+        ka, km = jax.random.split(key)
+        return {
+            "attn_norm": self._norm().init(key),
+            "attn": LlamaAttention(self.config).init(ka),
+            "mlp_norm": self._norm().init(key),
+            "moe": self._moe().init(km),
+        }
+
+    def specs(self) -> Params:
+        return {
+            "attn_norm": self._norm().specs(),
+            "attn": LlamaAttention(self.config).specs(),
+            "mlp_norm": self._norm().specs(),
+            "moe": self._moe().specs(),
+        }
+
+    def __call__(self, params, x, sin, cos, positions):
+        """Returns (x, aux_loss) — aux is this layer's load-balancing loss."""
+        c = self.config
+        h = self._norm()(params["attn_norm"], x)
+        x = x + LlamaAttention(c)(params["attn"], h, sin, cos, positions)
+        h = self._norm()(params["mlp_norm"], x)
+        y, router_logits, idx = self._moe()(params["moe"], h)
+        aux = load_balancing_loss(router_logits, idx, c.num_experts)
+        return x + y, aux
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralForCausalLM:
+    """Same protocol as LlamaForCausalLM; ``loss`` adds
+    ``router_aux_loss_coef · mean(per-layer aux)``."""
+
+    config: MixtralConfig
+
+    def _llama(self) -> LlamaForCausalLM:
+        # reuse embed/lm-head/final-norm/logits/loss-tail machinery
+        return LlamaForCausalLM(self.config)
+
+    def _layer(self) -> MixtralDecoderLayer:
+        return MixtralDecoderLayer(self.config)
+
+    # protocol delegators (checkpoint converters and facades call these on
+    # any causal-LM model)
+    def _embed(self):
+        return self._llama()._embed()
+
+    def _norm(self):
+        return self._llama()._norm()
+
+    def _logits(self, params: Params, hidden: jax.Array) -> jax.Array:
+        return self._llama()._logits(params, hidden)
+
+    def init(self, key: jax.Array) -> Params:
+        c = self.config
+        ke, kl, kh = jax.random.split(key, 3)
+        layer_keys = jax.random.split(kl, c.num_layers)
+        layers = jax.vmap(self._layer().init)(layer_keys)
+        params = {
+            "embed": self._llama()._embed().init(ke),
+            "layers": layers,
+            "final_norm": self._llama()._norm().init(kh),
+        }
+        if not c.tie_word_embeddings:
+            params["lm_head"] = self._llama()._lm_head().init(kh)
+        return params
+
+    def specs(self) -> Params:
+        c = self.config
+        layer_specs = jax.tree.map(
+            lambda s: P(None, *s), self._layer().specs(),
+            is_leaf=lambda s: isinstance(s, P),
+        )
+        specs = {
+            "embed": self._llama()._embed().specs(),
+            "layers": layer_specs,
+            "final_norm": self._llama()._norm().specs(),
+        }
+        if not c.tie_word_embeddings:
+            specs["lm_head"] = self._llama()._lm_head().specs()
+        return specs
+
+    def _backbone(
+        self, params: Params, input_ids: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Embed + MoE decoder stack + final norm.
+        Returns (hidden (B,S,H), mean aux loss)."""
+        c = self.config
+        b, s = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        sin, cos = precompute_rope(c.head_dim, s, c.rope_theta, c.rope_scaling)
+        x = self._llama()._embed()(params["embed"], input_ids)
+        if parallel_state.sequence_parallel_enabled():
+            x = constrain(x, P(BATCH_AXES, TP_AXIS, None))
+
+        layer = self._layer()
+
+        def body(x, layer_params):
+            y, aux = layer(layer_params, x, sin, cos, positions)
+            return y, aux
+
+        policy = _remat_policy(c.remat)
+        if policy is not None:
+            body = jax.checkpoint(body, policy=policy)
+        if c.scan_layers:
+            x, aux = lax.scan(body, x, params["layers"])
+            aux = jnp.mean(aux)
+        else:
+            auxes = []
+            for i in range(c.num_layers):
+                x, a = body(x, jax.tree.map(lambda p: p[i], params["layers"]))
+                auxes.append(a)
+            aux = jnp.mean(jnp.stack(auxes))
+        x = self._llama()._norm()(params["final_norm"], x)
+        if parallel_state.sequence_parallel_enabled():
+            x = constrain(x, P(BATCH_AXES, None, None))
+        return x, aux
+
+    def __call__(self, params: Params, input_ids: jax.Array) -> jax.Array:
+        hidden, _ = self._backbone(params, input_ids)
+        return self._llama()._logits(params, hidden)
+
+    def loss_from_hidden(self, params, hidden, labels):
+        return self._llama().loss_from_hidden(params, hidden, labels)
+
+    def loss(
+        self, params: Params, input_ids: jax.Array, labels: jax.Array
+    ) -> jax.Array:
+        hidden, aux = self._backbone(params, input_ids)
+        ce = self._llama().loss_from_hidden(params, hidden, labels)
+        return ce + self.config.router_aux_loss_coef * aux

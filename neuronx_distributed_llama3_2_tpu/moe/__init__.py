@@ -1,0 +1,29 @@
+"""Mixture-of-Experts zoo (reference ``modules/moe/``, SURVEY.md §2.5).
+
+Role map:
+  routing.py  ← modules/moe/routing.py (RouterTopK :89, RouterSinkhorn :123)
+  experts.py  ← modules/moe/expert_mlps.py + moe_parallel_layers.py (fused 3D)
+  model.py    ← modules/moe/model.py (MoE :7) + experts.py EP entry/exit
+  loss.py     ← modules/moe/loss_function.py (Switch LB loss :5)
+"""
+
+from neuronx_distributed_llama3_2_tpu.moe.experts import ExpertMLPs
+from neuronx_distributed_llama3_2_tpu.moe.loss import load_balancing_loss
+from neuronx_distributed_llama3_2_tpu.moe.model import MoE, MoEConfig
+from neuronx_distributed_llama3_2_tpu.moe.routing import (
+    Router,
+    sinkhorn,
+    sinkhorn_routing,
+    top_k_routing,
+)
+
+__all__ = [
+    "ExpertMLPs",
+    "MoE",
+    "MoEConfig",
+    "Router",
+    "load_balancing_loss",
+    "sinkhorn",
+    "sinkhorn_routing",
+    "top_k_routing",
+]

@@ -1,0 +1,176 @@
+"""Expert MLPs: fused 3D expert weights + static-shape dispatch paths.
+
+TPU-native replacement for the reference's ``modules/moe/expert_mlps.py``
+(``ExpertMLPs`` :13) and ``moe_parallel_layers.py`` (fused 3D
+``ExpertFusedColumnParallelLinear`` :141 / ``...RowParallelLinear`` :227).
+
+Weights are *global* 3D arrays with PartitionSpecs — expert dim over ``ep``,
+intermediate dim over ``tp`` — instead of the reference's per-rank
+``num_experts/ep``-sized locals (:166). Three forward paths mirror the
+reference's dispatch (:298-357):
+
+- ``forward_all_experts`` (:139): every token × every expert, no permutation —
+  cheapest when T is small (token-gen).
+- ``forward_capacity_factor`` (:169): static-shape token dropping. Capacity
+  ``C = ceil(T·top_k·cf/E)``; position-in-expert via a cumsum over the
+  token-major flattened assignment (the reference computes this cumsum with a
+  tril matmul in fp64, tensor_utils.py — here a plain fp32 ``jnp.cumsum``,
+  per SURVEY §7's fp64→fp32 substitution); tokens beyond capacity dropped;
+  scatter to (E, C, H), batched expert einsum on the MXU, gather back and
+  scale by gates.
+- EP execution lives in :mod:`.model` (shard_map + all-to-all); the math here
+  is mesh-agnostic global code usable inside or outside shard_map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.parallel.state import EP_AXIS, TP_AXIS
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpertMLPs:
+    """Fused gate_up/down projections for E experts (SwiGLU)."""
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    capacity_factor: Optional[float] = None  # None => all-experts path
+    glu: bool = True
+    dtype: Any = jnp.bfloat16
+
+    def init(self, key: jax.Array) -> Params:
+        e, h, i = self.num_experts, self.hidden_size, self.intermediate_size
+        kg, kd = jax.random.split(key)
+        scale = 0.02
+        n_up = 2 if self.glu else 1
+        gate_up = (
+            jax.random.normal(kg, (e, h, n_up, i), jnp.float32) * scale
+        ).astype(self.dtype)
+        down = (
+            jax.random.normal(kd, (e, i, h), jnp.float32) * scale
+        ).astype(self.dtype)
+        return {"gate_up": gate_up, "down": down}
+
+    def specs(self) -> Params:
+        """Expert dim over ep, intermediate over tp — the GSPMD equivalent of
+        the reference's (e_local, in, out/tp) shards (moe_parallel_layers.py
+        :141,:227 partition_dim tables)."""
+        return {
+            "gate_up": P(EP_AXIS, None, None, TP_AXIS),
+            "down": P(EP_AXIS, TP_AXIS, None),
+        }
+
+    # -- expert math (shared by both dispatch paths) ----------------------
+
+    def _mlp(self, params: Params, x: jax.Array) -> jax.Array:
+        """Batched per-expert MLP: x (E, C, H) -> (E, C, H). One einsum pair
+        over the whole expert batch → large MXU matmuls (reference einsum
+        'e...h,ehi->e...i', moe_parallel_layers.py:13)."""
+        h1 = jnp.einsum("ech,ehti->ecti", x, params["gate_up"])
+        if self.glu:
+            gate, up = h1[:, :, 0], h1[:, :, 1]
+            act = jax.nn.silu(gate) * up
+        else:
+            act = jax.nn.silu(h1[:, :, 0])
+        return jnp.einsum("eci,eio->eco", act, params["down"])
+
+    # -- dispatch paths ----------------------------------------------------
+
+    def forward_all_experts(
+        self, params: Params, x: jax.Array, gates: jax.Array, idx: jax.Array
+    ) -> jax.Array:
+        """Every token through every expert, combine by gate (reference
+        forward_all_experts expert_mlps.py:139). x (T,H), gates/idx (T,k)."""
+        t = x.shape[0]
+        xb = jnp.broadcast_to(x, (self.num_experts, t, x.shape[1]))
+        y_all = self._mlp(params, xb)  # (E, T, H)
+        # combine: for each token, sum over its k chosen experts
+        combine = jnp.zeros((t, self.num_experts), jnp.float32)
+        combine = combine.at[
+            jnp.arange(t)[:, None], idx
+        ].add(gates)  # (T, E)
+        return jnp.einsum(
+            "te,eth->th", combine.astype(x.dtype), y_all
+        )
+
+    def capacity(self, num_tokens: int, top_k: int) -> int:
+        """C = ceil(T·k·cf/E) (reference expert_mlps.py:169)."""
+        assert self.capacity_factor is not None
+        return max(
+            1,
+            math.ceil(
+                num_tokens * top_k * self.capacity_factor / self.num_experts
+            ),
+        )
+
+    def dispatch(
+        self, x: jax.Array, gates: jax.Array, idx: jax.Array, capacity: int
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Scatter tokens into (E, C, H) expert buffers.
+
+        Returns (buffers (E,C,H), slot (T·k,) flat slot index with dummy E·C
+        for dropped, keep (T·k,) fp32 mask). Position-in-expert is assigned
+        token-major: earlier tokens win capacity (reference cumsum ordering,
+        expert_mlps.py:169+tensor_utils)."""
+        t, k = idx.shape
+        e, c = self.num_experts, capacity
+        e_flat = idx.reshape(-1)  # (T·k,) token-major
+        onehot = (
+            e_flat[:, None] == jnp.arange(e, dtype=e_flat.dtype)[None, :]
+        ).astype(jnp.float32)
+        # fp32 cumsum is exact for counts up to 2^24 — far beyond any T·k
+        pos = jnp.take_along_axis(
+            jnp.cumsum(onehot, axis=0) - 1.0, e_flat[:, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        keep = (pos < c).astype(jnp.float32)
+        slot = jnp.where(
+            pos < c, e_flat * c + pos.astype(jnp.int32), e * c
+        ).astype(jnp.int32)
+        x_rep = jnp.repeat(x, k, axis=0)  # (T·k, H) token-major
+        buf = jnp.zeros((e * c + 1, x.shape[1]), x.dtype)
+        buf = buf.at[slot].add(x_rep * keep[:, None].astype(x.dtype))
+        return buf[: e * c].reshape(e, c, -1), slot, keep
+
+    def combine(
+        self,
+        y: jax.Array,
+        slot: jax.Array,
+        keep: jax.Array,
+        gates: jax.Array,
+        num_tokens: int,
+    ) -> jax.Array:
+        """Gather expert outputs back to tokens and scale by gate affinity
+        (dropped tokens contribute zero — reference unpermute+affinity-scale,
+        expert_mlps.py:169)."""
+        e, c, h = y.shape
+        y_pad = jnp.concatenate([y.reshape(e * c, h), jnp.zeros((1, h), y.dtype)])
+        out_tk = y_pad[slot] * (keep * gates.reshape(-1))[:, None].astype(y.dtype)
+        return jnp.sum(out_tk.reshape(num_tokens, -1, h), axis=1)
+
+    def forward_capacity_factor(
+        self, params: Params, x: jax.Array, gates: jax.Array, idx: jax.Array
+    ) -> jax.Array:
+        """Static-shape capacity-factor dispatch (reference
+        forward_capacity_factor expert_mlps.py:169). x (T,H)."""
+        t = x.shape[0]
+        cap = self.capacity(t, idx.shape[1])
+        buf, slot, keep = self.dispatch(x, gates, idx, cap)
+        y = self._mlp(params, buf)
+        return self.combine(y, slot, keep, gates, t)
+
+    def __call__(
+        self, params: Params, x: jax.Array, gates: jax.Array, idx: jax.Array
+    ) -> jax.Array:
+        if self.capacity_factor is None:
+            return self.forward_all_experts(params, x, gates, idx)
+        return self.forward_capacity_factor(params, x, gates, idx)

@@ -1,0 +1,33 @@
+"""MoE load-balancing loss.
+
+TPU-native port of the reference's Switch-Transformer auxiliary loss
+(``modules/moe/loss_function.py:5``): ``E/top_k · Σ_e f_e · P_e`` where
+``f_e`` is the fraction of (token, k)-assignments routed to expert ``e`` and
+``P_e`` the mean router probability of ``e``. The reference computes the
+softmax in fp64; fp32 here (TPU has no fast fp64).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def load_balancing_loss(
+    router_logits: jax.Array, expert_idx: jax.Array, num_experts: int
+) -> jax.Array:
+    """router_logits (T, E) fp32; expert_idx (T, k) int32 — the chosen
+    experts. Returns scalar fp32 aux loss (1.0 at perfect balance)."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top_k = expert_idx.shape[-1]
+    # top-k one-hot via compare-to-arange (reference loss_function.py one-hot
+    # trick) summed over the k choices
+    assigned = jnp.sum(
+        (expert_idx[..., None] == jnp.arange(num_experts)[None, None, :]).astype(
+            jnp.float32
+        ),
+        axis=1,
+    )  # (T, E)
+    f = jnp.mean(assigned, axis=0) / top_k   # fraction of assignments per expert
+    p = jnp.mean(probs, axis=0)              # mean router prob per expert
+    return num_experts * jnp.sum(f * p)

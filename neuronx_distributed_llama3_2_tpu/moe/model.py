@@ -1,0 +1,207 @@
+"""MoE top module: router → dispatch → (EP all-to-all) → experts → combine.
+
+TPU-native replacement for the reference's ``modules/moe/model.py`` (``MoE``
+:7): SP exit all-gather → flatten (S,B,H)→(T,H) → router → ExpertMLPs → SP
+re-entry (:112-150), returning router logits for the load-balancing loss.
+
+Execution has two paths:
+
+- **ep == 1** (or uninitialized mesh): pure global math; GSPMD handles tp/dp
+  from the weight specs.
+- **ep > 1**: a partial-manual ``shard_map`` over (dp, ep) — tokens stay
+  sharded, each shard dispatches its tokens into per-expert buffers, and the
+  ``enter/exit_expert_parallel_region`` all-to-alls from
+  :mod:`..parallel.mappings` (reference mappings.py:412-486) move token
+  buffers to the ep-ranks that own the experts. tp stays GSPMD-auto inside
+  the body (same hybrid technique as the pipeline executor). Capacity is
+  computed on shard-local token counts, matching the reference's rank-local
+  capacity semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.moe.experts import ExpertMLPs
+from neuronx_distributed_llama3_2_tpu.moe.routing import (
+    Router,
+    sinkhorn_routing,
+    top_k_routing,
+)
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.mappings import (
+    enter_expert_parallel_region,
+    exit_expert_parallel_region,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.state import (
+    DP_AXIS,
+    EP_AXIS,
+    TP_AXIS,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    intermediate_size: int
+    num_experts: int
+    top_k: int = 2
+    # None => all-experts path (no dropping); reference SELECTIVE_LOADING /
+    # forward_all_experts dispatch (expert_mlps.py:298-357)
+    capacity_factor: Optional[float] = None
+    routing: str = "topk"  # "topk" | "sinkhorn"
+    normalize_top_k: bool = True
+    sinkhorn_iterations: int = 3
+    glu: bool = True
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.routing not in ("topk", "sinkhorn"):
+            raise ValueError(f"routing must be topk|sinkhorn, got {self.routing!r}")
+        if not 1 <= self.top_k <= self.num_experts:
+            raise ValueError("need 1 <= top_k <= num_experts")
+
+
+@dataclasses.dataclass(frozen=True)
+class MoE:
+    """The MoE block. ``__call__(params, x (B,S,H))`` →
+    ``(y (B,S,H), router_logits (T,E), expert_idx (T,k))``."""
+
+    config: MoEConfig
+
+    def _router(self) -> Router:
+        c = self.config
+        return Router(c.hidden_size, c.num_experts, c.dtype)
+
+    def _experts(self) -> ExpertMLPs:
+        c = self.config
+        return ExpertMLPs(
+            num_experts=c.num_experts,
+            hidden_size=c.hidden_size,
+            intermediate_size=c.intermediate_size,
+            capacity_factor=c.capacity_factor,
+            glu=c.glu,
+            dtype=c.dtype,
+        )
+
+    def init(self, key: jax.Array) -> Params:
+        kr, ke = jax.random.split(key)
+        return {
+            "router": self._router().init(kr),
+            "experts": self._experts().init(ke),
+        }
+
+    def specs(self) -> Params:
+        return {
+            "router": self._router().specs(),
+            "experts": self._experts().specs(),
+        }
+
+    def _route(self, router_params: Params, x_flat: jax.Array):
+        c = self.config
+        logits = self._router()(router_params, x_flat)
+        if c.routing == "sinkhorn":
+            gates, idx = sinkhorn_routing(
+                logits, c.top_k, c.sinkhorn_iterations, c.normalize_top_k
+            )
+        else:
+            gates, idx = top_k_routing(logits, c.top_k, c.normalize_top_k)
+        return logits, gates, idx
+
+    def _ep_size(self) -> int:
+        if not parallel_state.model_parallel_is_initialized():
+            return 1
+        return parallel_state.get_expert_model_parallel_size()
+
+    def __call__(
+        self, params: Params, x: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        b, s, h = x.shape
+        x_flat = x.reshape(b * s, h)  # (T, H) — reference flatten :112
+        if self._ep_size() > 1:
+            y, logits, idx = self._ep_forward(params, x_flat)
+        else:
+            logits, gates, idx = self._route(params["router"], x_flat)
+            y = self._experts()(params["experts"], x_flat, gates, idx)
+        return y.reshape(b, s, h), logits, idx
+
+    # -- EP execution ------------------------------------------------------
+
+    def _ep_forward(self, params: Params, x_flat: jax.Array):
+        """shard_map over (dp, ep): dispatch shard-local tokens, all-to-all
+        token buffers onto the expert-owning ep ranks (reference
+        enter/exit_expert_parallel_region choreography, mappings.py:412-486 +
+        Experts EP entry/exit, experts.py:121-152), run the local experts,
+        all-to-all back, combine."""
+        c = self.config
+        experts = self._experts()
+        mesh = parallel_state.get_parallel_state().mesh
+        t = x_flat.shape[0]
+        dp_ep = mesh.shape[DP_AXIS] * mesh.shape[EP_AXIS]
+        if t % dp_ep != 0:
+            raise ValueError(
+                f"token count {t} not divisible by dp*ep {dp_ep}"
+            )
+
+        # XLA:CPU (the virtual test mesh) crashes compiling the gradient psum
+        # of a bf16 weight replicated over manual mesh axes ("Invalid binary
+        # instruction opcode copy"). Round-trip the expert weights through
+        # fp32 across the shard_map boundary on cpu only — the cast transpose
+        # makes the dp grad-psum fp32. Exact (bf16→f32→bf16) and TPU keeps
+        # native bf16.
+        upcast = jax.default_backend() == "cpu" and c.dtype == jnp.bfloat16
+        expert_params = params["experts"]
+        if upcast:
+            expert_params = jax.tree.map(
+                lambda a: a.astype(jnp.float32), expert_params
+            )
+
+        if c.capacity_factor is None:
+            # A no-drop EP dispatch must size every expert buffer for the
+            # all-tokens-to-one-expert worst case: E× the necessary a2a bytes
+            # and expert FLOPs. Refuse instead of silently collapsing
+            # throughput; cf=num_experts/top_k already guarantees no dropping
+            # under perfect balance and is the sane upper region.
+            raise ValueError(
+                "expert parallelism (ep > 1) requires a capacity_factor; "
+                "capacity_factor=None (all-experts dispatch) would buffer "
+                "T·top_k slots per expert. Set e.g. capacity_factor="
+                f"{float(c.num_experts) / c.top_k:g} for a no-drop-at-balance "
+                "budget."
+            )
+
+        def body(router_p, expert_p, xl):
+            # xl: (T_loc, H) shard-local tokens
+            if upcast:
+                expert_p = jax.tree.map(lambda a: a.astype(c.dtype), expert_p)
+            logits, gates, idx = self._route(router_p, xl)
+            cap = experts.capacity(xl.shape[0], c.top_k)
+            buf, slot, keep = experts.dispatch(xl, gates, idx, cap)
+            # (E, C, H) -> (E/ep, ep·C, H): tokens travel to expert owners
+            buf = enter_expert_parallel_region(buf)
+            y = experts._mlp(expert_p, buf)
+            # (E/ep, ep·C, H) -> (E, C, H): outputs return to token owners
+            y = exit_expert_parallel_region(y)
+            out = experts.combine(y, slot, keep, gates, xl.shape[0])
+            return out, logits, idx
+
+        token_spec = P((DP_AXIS, EP_AXIS))
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P(),                      # router weights replicated
+                P(EP_AXIS),               # expert dim manual over ep
+                token_spec,               # tokens sharded over (dp, ep)
+            ),
+            out_specs=(token_spec, token_spec, token_spec),
+            axis_names={DP_AXIS, EP_AXIS},
+            check_vma=False,
+        )(params["router"], expert_params, x_flat)

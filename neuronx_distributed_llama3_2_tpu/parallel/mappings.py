@@ -25,6 +25,7 @@ are not needed — XLA inserts equivalent collectives from annotations.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from neuronx_distributed_llama3_2_tpu.parallel.state import EP_AXIS, TP_AXIS
@@ -114,7 +115,21 @@ def all_to_all_expert_parallel(
     x: jax.Array, split_dim: int, concat_dim: int
 ) -> jax.Array:
     """All-to-all over the ep axis (reference
-    _AllToAllInExpertParallelRegion mappings.py:311; raw op :149)."""
+    _AllToAllInExpertParallelRegion mappings.py:311; raw op :149).
+
+    XLA:CPU (the virtual test mesh) crashes compiling the *gradient* of a
+    bf16 all-to-all ("Invalid binary instruction opcode copy"), so on the cpu
+    backend sub-fp32 payloads ride the wire as fp32. TPU is unaffected and
+    keeps the narrow dtype (half the ICI bytes)."""
+    if jax.default_backend() == "cpu" and x.dtype in (
+        jnp.bfloat16,
+        jnp.float16,
+    ):
+        orig = x.dtype
+        return lax.all_to_all(
+            x.astype(jnp.float32), EP_AXIS, split_axis=split_dim,
+            concat_axis=concat_dim, tiled=True,
+        ).astype(orig)
     return lax.all_to_all(
         x, EP_AXIS, split_axis=split_dim, concat_axis=concat_dim, tiled=True
     )
@@ -128,10 +143,10 @@ def enter_expert_parallel_region(x: jax.Array) -> jax.Array:
     ep = lax.axis_size(EP_AXIS)
     if e % ep != 0:
         raise ValueError(f"num experts {e} not divisible by ep {ep}")
-    return lax.all_to_all(x, EP_AXIS, split_axis=0, concat_axis=1, tiled=True)
+    return all_to_all_expert_parallel(x, 0, 1)
 
 
 def exit_expert_parallel_region(x: jax.Array) -> jax.Array:
     """Inverse of :func:`enter_expert_parallel_region`
     (reference mappings.py:452)."""
-    return lax.all_to_all(x, EP_AXIS, split_axis=1, concat_axis=0, tiled=True)
+    return all_to_all_expert_parallel(x, 1, 0)

@@ -60,6 +60,16 @@ class PipelinedCausalLM:
     model: LlamaForCausalLM
     num_microbatches: int
 
+    def __post_init__(self):
+        # The stage scan carries a plain hidden-state; MoE decoder layers
+        # return (x, aux) and their router aux loss would be dropped by the
+        # pipelined loss path. Reject rather than miscompute.
+        if not isinstance(self.model, LlamaForCausalLM):
+            raise TypeError(
+                f"PipelinedCausalLM supports LlamaForCausalLM only, got "
+                f"{type(self.model).__name__} (MoE models are not pipelined yet)"
+            )
+
     @property
     def config(self):
         return self.model.config
