@@ -1,0 +1,264 @@
+"""Launchable Llama pretraining with checkpoint/resume.
+
+TPU-native equivalent of the reference's canonical pretrain entrypoints
+(``examples/training/llama/tp_zero1_llama_hf_pretrain/tp_zero1_llama_hf_pretrain.py:277-350``
+train loop; ``tp_pp_llama_hf_pretrain/run_llama_nxd.py:204-239`` resume via
+``load_checkpoint(tag="latest_if_exists")``). One process drives the whole
+mesh — no torchrun/xmp.spawn.
+
+Usage (tiny smoke run on the 8-device CPU mesh):
+
+    python examples/pretrain_llama.py --model tiny --cpu-devices 8 \
+        --tp 2 --global-batch 8 --seq-len 64 --steps 10 --synthetic 200000 \
+        --ckpt-dir /tmp/ckpt --save-every 5
+
+Re-running the same command resumes from the latest checkpoint.
+
+Pipelined runs save parameters in canonical (L, ...) layer layout
+(``from_pipeline`` before save, ``to_pipeline`` after load) so a checkpoint
+written at pp=2 resumes at pp=4 or pp=1 (elastic pp resharding — the advisor
+gap on shape-locked pipelined saves).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="tiny", help="LLAMA_CONFIGS key")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1)
+    p.add_argument("--sp", action="store_true", help="sequence parallelism")
+    p.add_argument("--global-batch", type=int, default=8)
+    p.add_argument("--microbatches", type=int, default=1)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--warmup-steps", type=int, default=10)
+    p.add_argument("--data", help="path to a .npy token stream")
+    p.add_argument(
+        "--synthetic", type=int, default=0,
+        help="generate a synthetic token stream of this many tokens",
+    )
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--save-every", type=int, default=50)
+    p.add_argument("--async-save", action="store_true")
+    p.add_argument("--keep-ckpts", type=int, default=3)
+    p.add_argument("--metrics-file", default=None)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument(
+        "--cpu-devices", type=int, default=0,
+        help="force an n-device virtual CPU mesh (testing)",
+    )
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    import jax
+
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from neuronx_distributed_llama3_2_tpu.data import (
+        DistributedDataLoader,
+        LoaderState,
+        TokenDataset,
+        batch_to_device,
+        write_token_file,
+    )
+    from neuronx_distributed_llama3_2_tpu.models import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+    )
+    from neuronx_distributed_llama3_2_tpu.pipeline import PipelinedCausalLM
+    from neuronx_distributed_llama3_2_tpu.trainer import (
+        OptimizerConfig,
+        TrainState,
+        TrainingConfig,
+        initialize_parallel_model,
+        make_train_step,
+    )
+    from neuronx_distributed_llama3_2_tpu.trainer.metrics import (
+        Throughput,
+        TrainingMetrics,
+    )
+    from neuronx_distributed_llama3_2_tpu.trainer.optimizer import (
+        OptimizerState,
+        optimizer_state_specs,
+    )
+    from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+    logger = get_logger()
+
+    model_cfg = dataclasses.replace(
+        LLAMA_CONFIGS[args.model], max_seq_len=max(
+            args.seq_len, LLAMA_CONFIGS[args.model].max_seq_len
+        )
+    )
+    config = TrainingConfig(
+        tensor_parallel_size=args.tp,
+        pipeline_parallel_size=args.pp,
+        expert_parallel_size=args.ep,
+        sequence_parallel=args.sp,
+        # under pp the pipelined model does its own microbatching; the
+        # trainer-level grad-accum loop must not split the batch again
+        num_microbatches=1 if args.pp > 1 else args.microbatches,
+        seed=args.seed,
+        optimizer=OptimizerConfig(
+            learning_rate=args.lr,
+            warmup_steps=args.warmup_steps,
+            total_steps=args.steps,
+        ),
+    )
+    config.initialize()
+
+    base_model = LlamaForCausalLM(model_cfg)
+    pipelined = args.pp > 1
+    model = (
+        PipelinedCausalLM(base_model, num_microbatches=max(args.microbatches, args.pp))
+        if pipelined
+        else base_model
+    )
+
+    # -- data -------------------------------------------------------------
+    data_path = args.data
+    if args.synthetic:
+        data_path = os.path.join(args.ckpt_dir, "synthetic_tokens.npy")
+        if not os.path.exists(data_path):
+            os.makedirs(args.ckpt_dir, exist_ok=True)
+            rng = np.random.default_rng(args.seed)
+            write_token_file(
+                data_path,
+                rng.integers(
+                    0, model_cfg.vocab_size, args.synthetic, dtype=np.int32
+                ),
+            )
+    if not data_path:
+        raise SystemExit("pass --data FILE.npy or --synthetic N")
+    loader = DistributedDataLoader(
+        TokenDataset(data_path, args.seq_len),
+        args.global_batch,
+        seed=args.seed,
+    )
+
+    # -- model/optimizer state (fresh, then maybe overwritten by resume) ---
+    state, _ = initialize_parallel_model(model, config)
+    step_fn = make_train_step(model, config)
+    mesh = None  # default: live parallel state's mesh
+
+    # canonical (L, ...) layout templates/specs for elastic-pp checkpoints
+    def to_canonical(tree):
+        return model.from_pipeline(tree) if pipelined else tree
+
+    def from_canonical(tree):
+        return model.to_pipeline(tree) if pipelined else tree
+
+    def opt_map(opt: OptimizerState, fn) -> OptimizerState:
+        return OptimizerState(
+            step=opt.step,
+            master=None if opt.master is None else fn(opt.master),
+            mu=fn(opt.mu),
+            nu=fn(opt.nu),
+        )
+
+    canonical_params_t = jax.eval_shape(to_canonical, state.params)
+    canonical_specs = base_model.specs()
+    canonical_opt_t = jax.eval_shape(
+        lambda o: opt_map(o, to_canonical), state.opt
+    )
+    canonical_opt_specs = optimizer_state_specs(
+        canonical_specs, canonical_params_t, config.optimizer
+    )
+
+    start_step = 0
+    loaded = load_checkpoint(
+        args.ckpt_dir,
+        tag="latest_if_exists",
+        model=canonical_params_t,
+        optimizer=canonical_opt_t,
+        model_specs=canonical_specs,
+        optimizer_specs=canonical_opt_specs,
+        mesh=mesh,
+    )
+    if loaded is not None:
+        state = TrainState(
+            params=from_canonical(loaded["model"]),
+            opt=opt_map(loaded["optimizer"], from_canonical),
+        )
+        uc = loaded.get("user_content") or {}
+        start_step = int(uc.get("step", 0))
+        loader.state = LoaderState.from_json(uc.get("loader", {}))
+        logger.info(
+            "resumed from %s at step %d", loaded["tag"], start_step
+        )
+
+    # -- train loop (reference tp_zero1_llama_hf_pretrain.py:277-350) -----
+    metrics_file = (
+        TrainingMetrics(args.metrics_file) if args.metrics_file else None
+    )
+    throughput = Throughput(args.global_batch)
+    batches = iter(loader)
+
+    def save(tag_step: int):
+        save_checkpoint(
+            args.ckpt_dir,
+            tag=f"step_{tag_step}",
+            model=to_canonical(state.params),
+            optimizer=opt_map(state.opt, to_canonical),
+            user_content={"step": tag_step, "loader": loader.state.to_json()},
+            async_save=args.async_save,
+            num_kept_ckpts=args.keep_ckpts,
+        )
+
+    for step in range(start_step, args.steps):
+        batch = next(batches)
+        ids = batch_to_device(batch, mesh)
+        t0 = time.perf_counter()
+        state, m = step_fn(state, {"input_ids": ids, "labels": ids})
+        loss = float(m["loss"])  # blocks until the step finished
+        if not np.isfinite(loss):
+            raise RuntimeError(f"non-finite loss {loss} at step {step}")
+        seqs_per_s = throughput.tick()
+        logger.info(
+            "step %d loss %.4f grad_norm %.3f lr %.2e (%.0f ms)%s",
+            step, loss, float(m["grad_norm"]), float(m["learning_rate"]),
+            (time.perf_counter() - t0) * 1e3,
+            f" {seqs_per_s:.2f} seq/s" if seqs_per_s else "",
+        )
+        if metrics_file:
+            metrics_file.log(
+                step, loss=loss, grad_norm=float(m["grad_norm"]),
+                lr=float(m["learning_rate"]),
+                seqs_per_s=seqs_per_s,
+            )
+        if (step + 1) % args.save_every == 0 and step + 1 < args.steps:
+            save(step + 1)
+    save(args.steps)
+    from neuronx_distributed_llama3_2_tpu.checkpoint import (
+        finalize_async_saves,
+    )
+
+    finalize_async_saves()
+    logger.info("done: %d steps", args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,11 +71,26 @@ def _from_npy(data: bytes) -> np.ndarray:
     return np.load(io.BytesIO(data), allow_pickle=False)
 
 
+def _is_writer() -> bool:
+    """Only process 0 touches storage (files, markers, GC, retention) in
+    multi-host runs — concurrent identical writes would race GC/markers
+    (advisor finding; the reference coordinates per-rank writes instead)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
 def _to_host(leaf) -> np.ndarray:
     """Device→host transfer; bfloat16 is stored via uint16 view (npy has no
-    bf16 dtype)."""
-    arr = np.asarray(leaf)
-    return arr
+    bf16 dtype). Multi-host: non-fully-addressable global arrays are gathered
+    collectively (every process must participate, even non-writers)."""
+    import jax
+
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(leaf)
 
 
 class CheckpointIOState:
@@ -98,8 +113,12 @@ class CheckpointIOState:
     def begin(self, tag: str) -> None:
         self._tag = str(tag)
         self._work = []
-        self.storage.makedirs(self._tag)
-        self.storage.mark_checkpoint(self._tag)
+        if _is_writer():
+            self.storage.makedirs(self._tag)
+            # overwriting a completed tag: drop its done marker first so a
+            # torn overwrite reads as incomplete, not as a valid mixed state
+            self.storage.unmark_done(self._tag)
+            self.storage.mark_checkpoint(self._tag)
 
     def add_tree(self, kind: str, tree: Any) -> None:
         flat = _flatten(tree)
@@ -154,6 +173,11 @@ class CheckpointIOState:
                 self._error.append(e)
                 raise
 
+        if not _is_writer():
+            # host transfers/gathers already happened in add_tree; nothing to
+            # write from non-zero processes
+            self._tag, self._work = None, []
+            return
         if self.async_save:
             t = threading.Thread(target=write, name=f"ckpt-save-{tag}", daemon=False)
             t.start()
@@ -212,23 +236,31 @@ def save_checkpoint(
     """Save pytrees under ``path/tag/`` (reference save_checkpoint
     checkpoint.py:571; kinds model/optim/scheduler/user_content mirror its
     sub-dirs and .pt files)."""
+    if num_kept_ckpts is not None and num_kept_ckpts < 1:
+        raise ValueError(
+            f"num_kept_ckpts must be >= 1 (or None for keep-all), got "
+            f"{num_kept_ckpts}"
+        )
     storage = create_checkpoint_storage(path)
-    storage.makedirs("")
     io_state = _io_state(storage, async_save)
     io_state.wait_all()  # only one in-flight async save per root (reference :99)
-    # GC only after the in-flight save completed — an in-progress tag looks
-    # exactly like an interrupted one
-    storage.garbage_collect_incomplete()
+    if _is_writer():
+        storage.makedirs("")
+        # GC only after the in-flight save completed — an in-progress tag
+        # looks exactly like an interrupted one
+        storage.garbage_collect_incomplete()
 
-    done = storage.list_tags()
     save_seq = 0
-    if done:
-        try:
-            save_seq = (
-                storage.load_json(f"{done[-1]}/meta.json").get("save_seq", 0) + 1
-            )
-        except Exception:
-            save_seq = len(done)
+    if _is_writer():  # non-writers discard save_seq; skip the storage reads
+        done = storage.list_tags()
+        if done:
+            try:
+                save_seq = (
+                    storage.load_json(f"{done[-1]}/meta.json").get("save_seq", 0)
+                    + 1
+                )
+            except Exception:
+                save_seq = len(done)
 
     io_state.begin(tag)
     if model is not None:

@@ -76,6 +76,14 @@ class BaseCheckpointStorage(ABC):
     def is_done(self, tag: str) -> bool:
         return self.file_exists(os.path.join(str(tag), DONE_MARKER))
 
+    def unmark_done(self, tag: str) -> None:
+        """Invalidate a tag before overwriting it (reference delete removes
+        ``done`` first, trainer/checkpoint.py:236-241) so an interrupted
+        overwrite is garbage-collected instead of read as a torn mix."""
+        marker = os.path.join(str(tag), DONE_MARKER)
+        if self.file_exists(marker):
+            self.remove_file(marker)
+
     def list_tags(self, completed_only: bool = True) -> List[str]:
         """Tags under the root, oldest-first by save order. A tag is a
         directory containing a ``checkpoint`` marker; only tags with a

@@ -1,0 +1,18 @@
+"""Data pipeline (reference training_utils.py:99 dataset loader +
+DistributedSampler, SURVEY.md §2.8)."""
+
+from neuronx_distributed_llama3_2_tpu.data.dataset import (
+    DistributedDataLoader,
+    LoaderState,
+    TokenDataset,
+    batch_to_device,
+    write_token_file,
+)
+
+__all__ = [
+    "DistributedDataLoader",
+    "LoaderState",
+    "TokenDataset",
+    "batch_to_device",
+    "write_token_file",
+]

@@ -1,0 +1,165 @@
+"""Tokenized-dataset loading with dp-sharded, deterministic, resumable
+batches.
+
+TPU-native replacement for the reference's training data pipeline
+(``examples/training/llama/training_utils.py:99`` ``create_pretraining_dataset``
+— torch DataLoader + DistributedSampler over tokenized examples). The
+single-controller redesign: one loader yields the *global* batch per step
+(each multi-host process materializes only its addressable rows via
+``jax.make_array_from_process_local_data``), with the DistributedSampler's
+determinism/resume semantics kept — per-epoch seeded shuffle and
+skip-to-step resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+class TokenDataset:
+    """A flat token stream stored as one ``.npy`` array (any int dtype),
+    cut into fixed-length samples. Memory-mapped: arbitrarily large files
+    cost no host RAM."""
+
+    def __init__(self, path: str, seq_len: int):
+        self.tokens = np.load(path, mmap_mode="r")
+        if self.tokens.ndim != 1:
+            raise ValueError(
+                f"token file must be a 1-D stream, got shape {self.tokens.shape}"
+            )
+        self.seq_len = seq_len
+
+    def __len__(self) -> int:
+        return len(self.tokens) // self.seq_len
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        s = self.seq_len
+        return np.asarray(self.tokens[i * s : (i + 1) * s], dtype=np.int32)
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Write a token stream (the synthetic-dataset helper used by tests and
+    the pretrain example's --synthetic mode)."""
+    np.save(path, np.asarray(tokens))
+
+
+@dataclasses.dataclass
+class LoaderState:
+    """Resumable position (reference: DistributedSampler.set_epoch + batch
+    skip on resume)."""
+
+    step: int = 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"step": self.step}
+
+    @staticmethod
+    def from_json(obj: Dict[str, Any]) -> "LoaderState":
+        return LoaderState(step=int(obj.get("step", 0)))
+
+
+class DistributedDataLoader:
+    """Yields (global_batch_size, seq_len) int32 batches forever.
+
+    Determinism: sample order within epoch e is ``rng(seed + e)``'s
+    permutation; a loader resumed at step k yields exactly the batches the
+    original would have yielded from step k.
+    """
+
+    def __init__(
+        self,
+        dataset: TokenDataset,
+        global_batch_size: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        state: Optional[LoaderState] = None,
+    ):
+        if len(dataset) < global_batch_size:
+            raise ValueError(
+                f"dataset has {len(dataset)} samples < global batch "
+                f"{global_batch_size}"
+            )
+        self.dataset = dataset
+        self.gbs = global_batch_size
+        self.seed = seed
+        self.shuffle = shuffle
+        self.state = state or LoaderState()
+        self.steps_per_epoch = len(dataset) // global_batch_size
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        # cached per epoch: the permutation is O(dataset) and must not run
+        # on the synchronous host path of every step
+        cached = getattr(self, "_order_cache", None)
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
+        n = self.steps_per_epoch * self.gbs
+        if not self.shuffle:
+            order = np.arange(n)
+        else:
+            order = np.random.default_rng(self.seed + epoch).permutation(
+                len(self.dataset)
+            )[:n]
+        self._order_cache = (epoch, order)
+        return order
+
+    def batch_at(self, step: int, rows: Optional[slice] = None) -> np.ndarray:
+        """Global batch for ``step``; pass ``rows`` to materialize only a
+        row range (multi-host processes read only their own share)."""
+        epoch, within = divmod(step, self.steps_per_epoch)
+        order = self._epoch_order(epoch)
+        idx = order[within * self.gbs : (within + 1) * self.gbs]
+        if rows is not None:
+            idx = idx[rows]
+        return np.stack([self.dataset[int(i)] for i in idx])
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        """Yields this process's rows of each global batch (the full batch
+        in single-process runs). Feed through :func:`batch_to_device`."""
+        import jax
+
+        n_proc = jax.process_count()
+        rows = None
+        if n_proc > 1:
+            if self.gbs % n_proc != 0:
+                raise ValueError(
+                    f"global batch {self.gbs} not divisible by {n_proc} "
+                    f"processes"
+                )
+            per = self.gbs // n_proc
+            rows = slice(jax.process_index() * per, (jax.process_index() + 1) * per)
+        while True:
+            batch = self.batch_at(self.state.step, rows=rows)
+            self.state.step += 1
+            yield batch
+
+
+def batch_to_device(batch: np.ndarray, mesh=None):
+    """Place a host batch on the mesh dp-sharded.
+
+    Single process: ``batch`` is the global batch, placed via ``device_put``.
+    Multi-host: ``batch`` is this process's local rows (what the loader
+    yields) assembled into the global array via
+    ``jax.make_array_from_process_local_data`` (the single-controller
+    equivalent of per-rank DataLoader sharding)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+    from neuronx_distributed_llama3_2_tpu.parallel.state import DP_AXIS, EP_AXIS
+
+    if mesh is None:
+        if not parallel_state.model_parallel_is_initialized():
+            return jnp.asarray(batch)
+        mesh = parallel_state.get_parallel_state().mesh
+    sharding = NamedSharding(mesh, P((DP_AXIS, EP_AXIS), None))
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(batch), sharding)
+    return jax.make_array_from_process_local_data(sharding, batch)

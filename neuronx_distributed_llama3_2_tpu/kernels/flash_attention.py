@@ -9,8 +9,8 @@ constraint :178). Two implementations behind one API:
   (lax.scan over KV blocks). Never materializes the (S, S) score matrix, so
   long-context memory is O(S·block); works on any backend; its backward is
   JAX autodiff through the scan (recomputes per-block, flash-style).
-- a Pallas TPU kernel is PLANNED as a drop-in behind :func:`flash_attention`;
-  today every call uses the reference implementation.
+- ``pallas_flash_attention``: the hand-written TPU kernel (fwd + dq + dkv
+  with custom VJP); :func:`flash_attention` dispatches to it on TPU.
 
 GQA is handled *inside* the kernel path by folding query-head groups into the
 batch rather than repeating K/V (the reference replicates KV heads instead,

@@ -1,0 +1,9 @@
+"""LoRA adapters (reference ``modules/lora/``, SURVEY.md §2.5)."""
+
+from neuronx_distributed_llama3_2_tpu.lora.model import (
+    LoraConfig,
+    LoraModel,
+    merge_lora,
+)
+
+__all__ = ["LoraConfig", "LoraModel", "merge_lora"]

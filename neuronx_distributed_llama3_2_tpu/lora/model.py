@@ -1,0 +1,193 @@
+"""LoRA: low-rank adapters over the parameter pytree.
+
+TPU-native replacement for the reference's LoRA stack (``modules/lora/``):
+``LoraConfig`` (config.py:6 — rank/alpha/rslora/target_modules/save options),
+``LoraModel`` module injection by name/regex (model.py:75, ``inject_adapter``
+:175), TP-aware ``LoraParallelLinear`` (tp_layer.py:19), merge/unmerge
+(layer.py:86-119, ``merge_lora`` model.py:357), adapter-only checkpoints
+(model.py:467-616).
+
+The torch version wraps ``nn.Module``s and monkey-patches forwards. The
+functional redesign: adapters are a *separate pytree* keyed by the paths of
+the base parameters they target. Training differentiates only the adapter
+tree (base weights are captured constants), so the optimizer state is
+rank-sized; the forward applies ``W + (alpha/r)·A@B`` built on the fly, which
+XLA fuses into the consuming matmuls. TP-awareness is inherited: A shards
+like the input dim of its target, B like the output dims
+(:func:`LoraModel.specs`), so the low-rank factors follow whatever mesh the
+base model uses — no LoraParallelLinear class needed.
+
+Adapter-only checkpoints are just ``save_checkpoint(model=lora_params)`` —
+the tree contains nothing but adapters by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+# default targets: attention projections (reference default target_modules)
+DEFAULT_TARGETS = (
+    r"attn/qkv/(q|k|v)_kernel$",
+    r"attn/o/kernel$",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """Reference LoraConfig (modules/lora/config.py:6)."""
+
+    r: int = 8
+    alpha: float = 16.0
+    # regexes matched against '/'-joined param paths
+    target_modules: Tuple[str, ...] = DEFAULT_TARGETS
+    # rsLoRA scaling alpha/sqrt(r) instead of alpha/r (config.py rslora)
+    use_rslora: bool = False
+    dtype: Any = None  # None = target dtype
+
+    def __post_init__(self):
+        if self.r < 1:
+            raise ValueError(f"LoRA rank must be >= 1, got {self.r}")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / (self.r ** 0.5 if self.use_rslora else self.r)
+
+
+def _iter_targets(params: Params, patterns) -> Dict[str, jax.Array]:
+    """path -> leaf for every parameter matching a target regex."""
+    out = {}
+
+    def visit(path, leaf):
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        if any(re.search(p, key) for p in patterns):
+            out[key] = leaf
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return out
+
+
+def _split_shape(shape) -> Tuple[Tuple[int, ...], int, Tuple[int, ...]]:
+    """(leading stack dims, in_features, out dims) of a kernel.
+
+    Kernels here are (in, out...) possibly with a leading layer-stack dim:
+    (in, out), (L, in, out), (L, in, t, out) [fused gate_up]."""
+    if len(shape) == 2:
+        return (), shape[0], (shape[1],)
+    return (shape[0],), shape[1], tuple(shape[2:])
+
+
+class LoraModel:
+    """Causal-LM protocol over adapter params only (init/specs/loss/__call__),
+    so the trainer, checkpoint and inference layers run unchanged with the
+    adapter tree as "the model parameters"."""
+
+    def __init__(self, base_model, base_params: Params, config: LoraConfig):
+        self.base = base_model
+        self.base_params = base_params
+        self.lora_config = config
+        self._targets = _iter_targets(base_params, config.target_modules)
+        if not self._targets:
+            raise ValueError(
+                f"no parameters match target_modules={config.target_modules}"
+            )
+
+    @property
+    def config(self):  # model-protocol passthrough (vocab size etc.)
+        return self.base.config
+
+    # -- adapter pytree ---------------------------------------------------
+
+    def init(self, key: jax.Array) -> Params:
+        """A ~ N(0, 1/r) (kaiming-ish), B = 0 — so the adapted model starts
+        exactly equal to the base (reference LoraLayer reset, layer.py)."""
+        cfg = self.lora_config
+        adapters: Params = {}
+        keys = jax.random.split(key, len(self._targets))
+        for k, (path, leaf) in zip(keys, sorted(self._targets.items())):
+            stack, fan_in, out_dims = _split_shape(leaf.shape)
+            dt = cfg.dtype or leaf.dtype
+            a = (
+                jax.random.normal(k, (*stack, fan_in, cfg.r), jnp.float32)
+                / (fan_in ** 0.5)
+            ).astype(dt)
+            b = jnp.zeros((*stack, cfg.r, *out_dims), dt)
+            adapters[path] = {"a": a, "b": b}
+        return adapters
+
+    def specs(self) -> Params:
+        """A inherits the target's input-dim sharding, B its output-dim
+        sharding (the role of the reference's LoraParallelLinear tp_layer.py:19
+        — expressed as specs instead of a class)."""
+        base_specs = _iter_targets(
+            self.base.specs(), self.lora_config.target_modules
+        )
+        out: Params = {}
+        for path, spec in base_specs.items():
+            parts = list(spec)
+            shape = self._targets[path].shape
+            nstack = 1 if len(shape) > 2 else 0
+            parts = parts + [None] * (len(shape) - len(parts))
+            stack_p = parts[:nstack]
+            in_p = parts[nstack]
+            out_p = parts[nstack + 1:]
+            out[path] = {
+                "a": P(*stack_p, in_p, None),
+                "b": P(*stack_p, None, *out_p),
+            }
+        return out
+
+    # -- forward ----------------------------------------------------------
+
+    def merged_params(self, adapters: Params) -> Params:
+        """base + scaling · A@B on the targets (reference merge math,
+        layer.py:86-119). Built inside jit: XLA fuses the add into consumers."""
+        scale = self.lora_config.scaling
+        flat_targets = dict(self._targets)
+
+        def visit(path, leaf):
+            key = "/".join(str(getattr(k, "key", k)) for k in path)
+            if key in flat_targets and key in self._adapter_cache:
+                ab = self._adapter_cache[key]
+                a, b = ab["a"], ab["b"]
+                stack, fan_in, out_dims = _split_shape(leaf.shape)
+                if stack:
+                    delta = jnp.einsum(
+                        "lir,lr...->li...", a.astype(jnp.float32),
+                        b.astype(jnp.float32),
+                    )
+                else:
+                    delta = jnp.einsum(
+                        "ir,r...->i...", a.astype(jnp.float32),
+                        b.astype(jnp.float32),
+                    )
+                return leaf + (scale * delta).astype(leaf.dtype)
+            return leaf
+
+        self._adapter_cache = adapters
+        try:
+            return jax.tree_util.tree_map_with_path(visit, self.base_params)
+        finally:
+            del self._adapter_cache
+
+    def __call__(self, adapters: Params, input_ids: jax.Array) -> jax.Array:
+        return self.base(self.merged_params(adapters), input_ids)
+
+    def loss(self, adapters: Params, input_ids, labels) -> jax.Array:
+        return self.base.loss(self.merged_params(adapters), input_ids, labels)
+
+
+def merge_lora(
+    base_model, base_params: Params, adapters: Params, config: LoraConfig
+) -> Params:
+    """Materialize merged weights for export/serving (reference merge_lora
+    model.py:357): returns a plain base-model param tree."""
+    return LoraModel(base_model, base_params, config).merged_params(adapters)

@@ -25,6 +25,14 @@ from neuronx_distributed_llama3_2_tpu.parallel.state import DP_AXIS, EP_AXIS, TP
 IGNORE_INDEX = -100  # positions with this label contribute zero loss
 
 
+def valid_token_mask(labels: jax.Array, vocab_size) -> jax.Array:
+    """The single source of truth for which label positions contribute loss:
+    in-range ids count, everything else (IGNORE_INDEX, out-of-vocab) doesn't.
+    Every CE numerator/denominator and the trainer's grad-accumulation
+    weights MUST use this same rule or microbatch weighting mis-scales."""
+    return (labels >= 0) & (labels < vocab_size)
+
+
 def _vocab_parallel_xent_body(
     logits: jax.Array, labels: jax.Array, label_smoothing: float
 ) -> jax.Array:
@@ -33,7 +41,7 @@ def _vocab_parallel_xent_body(
     vl = logits.shape[-1]
     idx = lax.axis_index(TP_AXIS)
     vocab_total = vl * lax.axis_size(TP_AXIS)
-    valid = (labels >= 0) & (labels < vocab_total)
+    valid = valid_token_mask(labels, vocab_total)
     labels = jnp.where(valid, labels, 0)
 
     # 1) stable max over the global vocab (reference :18)
@@ -142,7 +150,7 @@ def fused_linear_cross_entropy(
         hc, lc = chunk
         logits = logits_fn(hc)
         per_tok = parallel_cross_entropy(logits, lc, label_smoothing)
-        valid = (lc >= 0) & (lc < logits.shape[-1])
+        valid = valid_token_mask(lc, logits.shape[-1])
         s = jnp.sum(per_tok * valid.astype(jnp.float32))
         n = jnp.sum(valid.astype(jnp.float32))
         return (carry[0] + s, carry[1] + n), None
@@ -160,7 +168,7 @@ def cross_entropy(
     """Unsharded fallback with identical semantics. Labels outside
     [0, vocab) — including IGNORE_INDEX — contribute zero loss."""
     logits = logits.astype(jnp.float32)
-    valid = (labels >= 0) & (labels < logits.shape[-1])
+    valid = valid_token_mask(labels, logits.shape[-1])
     labels = jnp.where(valid, labels, 0)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     pred = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]

@@ -1,0 +1,123 @@
+"""Head-count padding for non-divisible tensor parallelism.
+
+TPU-native replacement for the reference's ``parallel_layers/pad.py``
+(``get_number_of_extra_heads`` :10, ``pad_model`` :28) and the inference
+GQA sharding transforms (``examples/inference/modules/gqa.py``:
+``replicate_kv`` :166, ``maybe_pad_interleaved`` :113): when tp does not
+divide the attention/KV head counts, pad the Q/O projections with zero heads
+and replicate KV heads so both counts become tp-divisible.
+
+The transformation is **forward-exact**: padded Q heads have all-zero query
+projections AND all-zero output-projection rows, so whatever their attention
+computes contributes nothing; replicated KV heads carry real (duplicated)
+weights and real Q-head groups are re-interleaved onto their copies exactly
+like the reference's ``kv_size_multiplier`` scheme (qkv_linear.py:454).
+
+Training caveat (documented divergence from the reference): the reference
+keeps replicated KV weights as *one* logical parameter by summing gradients
+over KV replica groups (qkv_linear.py:250-256). Here the padded model's KV
+copies are independent parameter entries — fine for inference / deployment
+resharding, but training the padded model optimizes a slightly different
+(more expressive) parametrization. Prefer tp ≤ num_kv_heads for training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+def get_number_of_extra_heads(num_heads: int, tp: int) -> int:
+    """Heads to add so tp | num_heads (reference pad.py:10)."""
+    return (-num_heads) % tp
+
+
+def gqa_padding_plan(
+    num_heads: int, num_kv_heads: int, tp: int
+) -> Tuple[int, int, list]:
+    """Compute (new_num_heads, new_num_kv_heads, q_slot_of_old_head).
+
+    KV heads are replicated ``m = tp / gcd(kv, tp)`` times (the reference's
+    kv_size_multiplier); each original KV head's Q-group of ``g`` heads is
+    split across its m copies and padded to ``ceil(g/m)`` slots per copy
+    (reference maybe_pad_interleaved, gqa.py:113).
+    ``q_slot_of_old_head[i]`` is the new position of original Q head i.
+    """
+    m = tp // math.gcd(num_kv_heads, tp)
+    new_kv = num_kv_heads * m
+    g = num_heads // num_kv_heads
+    gq = -(-g // m)  # ceil: Q slots per KV copy
+    new_n = new_kv * gq
+    slots = []
+    for j in range(num_kv_heads):  # original kv head
+        for qi in range(g):  # its qi-th query head
+            copy, pos = divmod(qi, gq)
+            slots.append((j * m + copy) * gq + pos)
+    return new_n, new_kv, slots
+
+
+def pad_llama_params_for_tp(params: Dict[str, Any], config, tp: int):
+    """Pad a Llama param pytree + config so tp divides both head counts.
+
+    Returns (new_config, new_params). Stacked-layer layout (leading L dim on
+    ``layers`` leaves) is preserved. Forward-exact (see module docstring).
+    """
+    import jax.numpy as jnp
+
+    n, kv, d = config.num_heads, config.num_kv_heads, config.head_dim
+    if n % tp == 0 and kv % tp == 0:
+        return config, params
+    new_n, new_kv, slots = gqa_padding_plan(n, kv, tp)
+    m = new_kv // kv
+    logger.warning(
+        "padding GQA heads for tp=%d: q %d->%d (zero heads), kv %d->%d "
+        "(replicated %dx) — forward-exact; see parallel/pad.py training caveat",
+        tp, n, new_n, kv, new_kv, m,
+    )
+
+    layers = params["layers"]
+    qkv = layers["attn"]["qkv"]
+    o = layers["attn"]["o"]
+
+    def pad_q(kernel):  # (L, H, n*d) -> (L, H, new_n*d), slot-permuted
+        L, H, _ = kernel.shape
+        out = jnp.zeros((L, H, new_n, d), kernel.dtype)
+        k4 = kernel.reshape(L, H, n, d)
+        out = out.at[:, :, jnp.asarray(slots)].set(k4)
+        return out.reshape(L, H, new_n * d)
+
+    def rep_kv(kernel):  # (L, H, kv*d) -> (L, H, new_kv*d), copies adjacent
+        L, H, _ = kernel.shape
+        k4 = kernel.reshape(L, H, kv, d)
+        k4 = jnp.repeat(k4, m, axis=2)
+        return k4.reshape(L, H, new_kv * d)
+
+    def pad_o(kernel):  # (L, n*d, H) -> (L, new_n*d, H), zero rows for pads
+        L, _, H = kernel.shape
+        out = jnp.zeros((L, new_n, d, H), kernel.dtype)
+        k4 = kernel.reshape(L, n, d, H)
+        out = out.at[:, jnp.asarray(slots)].set(k4)
+        return out.reshape(L, new_n * d, H)
+
+    new_params = dict(params)
+    new_layers = dict(layers)
+    new_attn = dict(layers["attn"])
+    new_attn["qkv"] = {
+        "q_kernel": pad_q(qkv["q_kernel"]),
+        "k_kernel": rep_kv(qkv["k_kernel"]),
+        "v_kernel": rep_kv(qkv["v_kernel"]),
+    }
+    new_attn["o"] = {"kernel": pad_o(o["kernel"])}
+    new_layers["attn"] = new_attn
+    new_params["layers"] = new_layers
+    new_config = dataclasses.replace(
+        config, num_heads=new_n, num_kv_heads=new_kv
+    )
+    return new_config, new_params

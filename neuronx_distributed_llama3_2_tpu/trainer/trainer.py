@@ -140,15 +140,37 @@ def make_train_step(
             mb_ids = input_ids.reshape(mbs, n_micro, -1).swapaxes(0, 1)
             mb_lbl = labels.reshape(mbs, n_micro, -1).swapaxes(0, 1)
             acc_dtype = jnp.float32 if opt_cfg.use_fp32_grad_acc else None
+            vocab = getattr(model.config, "vocab_size", None)
+
+            def valid_count(lbl):
+                # same validity rule as the CE kernel (shifted labels), via
+                # the shared single source of truth
+                from neuronx_distributed_llama3_2_tpu.parallel.loss import (
+                    valid_token_mask,
+                )
+
+                shifted = lbl[:, 1:]
+                ok = (
+                    valid_token_mask(shifted, vocab)
+                    if vocab is not None
+                    else shifted >= 0
+                )
+                return jnp.sum(ok.astype(jnp.float32))
 
             def micro(carry, mb):
-                acc, loss_acc = carry
+                # weight each microbatch's masked-mean loss/grads by its
+                # valid-token count so the accumulated step equals the
+                # global-batch mean CE even when padding is uneven across
+                # microbatches (advisor finding on equal-weight averaging)
+                acc, loss_acc, tok_acc = carry
                 ids, lbl = mb
                 loss, grads = grad_fn(state.params, ids, lbl)
+                n = valid_count(lbl)
                 acc = jax.tree.map(
-                    lambda a, g: a + (g.astype(a.dtype)), acc, grads
+                    lambda a, g: a + (g.astype(a.dtype) * n.astype(a.dtype)),
+                    acc, grads,
                 )
-                return (acc, loss_acc + loss), None
+                return (acc, loss_acc + loss * n, tok_acc + n), None
 
             zero = jax.tree.map(
                 lambda p: jnp.zeros(
@@ -156,11 +178,12 @@ def make_train_step(
                 ),
                 state.params,
             )
-            (grads, loss_sum), _ = jax.lax.scan(
-                micro, (zero, jnp.float32(0)), (mb_ids, mb_lbl)
+            (grads, loss_sum, tok_sum), _ = jax.lax.scan(
+                micro, (zero, jnp.float32(0), jnp.float32(0)), (mb_ids, mb_lbl)
             )
-            grads = jax.tree.map(lambda g: g / n_micro, grads)
-            loss = loss_sum / n_micro
+            denom = jnp.maximum(tok_sum, 1.0)
+            grads = jax.tree.map(lambda g: g / denom.astype(g.dtype), grads)
+            loss = loss_sum / denom
 
         new_params, new_opt, grad_norm = apply_gradients(
             state.opt,

@@ -1,0 +1,102 @@
+"""Non-divisibility guardrails (VERDICT #10; reference parallel_layers/pad.py
++ examples/inference/modules/gqa.py transforms)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LLAMA_CONFIGS,
+    LlamaForCausalLM,
+)
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.layers import shard_pytree
+from neuronx_distributed_llama3_2_tpu.parallel.pad import (
+    get_number_of_extra_heads,
+    gqa_padding_plan,
+    pad_llama_params_for_tp,
+)
+
+# 3B-shaped head geometry at small width: 24 heads / 8 kv — tp=8 divides
+# neither evenly once tp exceeds kv (the VERDICT tp=16 x 3B scenario scaled
+# to the 8-device CPU mesh: kv=3 forces replication+interleave).
+ODD = dataclasses.replace(
+    LLAMA_CONFIGS["tiny"], num_heads=6, num_kv_heads=3, head_dim=8,
+    hidden_size=48,
+)
+
+
+def test_extra_heads():
+    assert get_number_of_extra_heads(24, 16) == 8
+    assert get_number_of_extra_heads(32, 16) == 0
+
+
+def test_padding_plan():
+    # kv=3, tp=8 -> m=8, new_kv=24; g=2, gq=1, new_n=24
+    new_n, new_kv, slots = gqa_padding_plan(6, 3, 8)
+    assert new_kv % 8 == 0 and new_n % 8 == 0
+    assert len(slots) == 6 and len(set(slots)) == 6
+    # each original q head lands in the group of a copy of its kv head
+    gq = new_n // new_kv
+    m = new_kv // 3
+    for i, s in enumerate(slots):
+        kv_copy = s // gq
+        assert kv_copy // m == i // 2  # original kv head preserved
+
+
+def test_padded_model_forward_exact():
+    """Padded model logits == original (single device)."""
+    model = LlamaForCausalLM(ODD)
+    params = model.init(jax.random.key(0))
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, ODD.vocab_size, (2, 16)), jnp.int32
+    )
+    ref = jax.jit(model.__call__)(params, ids)
+    new_cfg, new_params = pad_llama_params_for_tp(params, ODD, tp=8)
+    assert new_cfg.num_heads % 8 == 0 and new_cfg.num_kv_heads % 8 == 0
+    out = jax.jit(LlamaForCausalLM(new_cfg).__call__)(new_params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_padded_model_runs_sharded():
+    """Padded model executes fully head-sharded at tp=8 and matches."""
+    model = LlamaForCausalLM(ODD)
+    params = model.init(jax.random.key(1))
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, ODD.vocab_size, (2, 16)), jnp.int32
+    )
+    ref = jax.jit(model.__call__)(params, ids)
+    new_cfg, new_params = pad_llama_params_for_tp(params, ODD, tp=8)
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size=8)
+    mesh = parallel_state.get_parallel_state().mesh
+    padded_model = LlamaForCausalLM(new_cfg)
+    sharded = shard_pytree(new_params, padded_model.specs(), mesh)
+    out = jax.jit(padded_model.__call__)(sharded, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_noop_when_divisible():
+    cfg = LLAMA_CONFIGS["tiny"]
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0))
+    new_cfg, new_params = pad_llama_params_for_tp(params, cfg, tp=4)
+    assert new_cfg is cfg and new_params is params
+
+
+def test_unsharded_fallback_warns():
+    """tp ∤ heads logs a loud warning — never silent (VERDICT weak #6)."""
+    from unittest import mock
+
+    from neuronx_distributed_llama3_2_tpu.models import llama
+
+    llama._warn_unsharded_heads.cache_clear()
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size=4)
+    with mock.patch(
+        "neuronx_distributed_llama3_2_tpu.utils.logger.get_logger"
+    ) as gl:
+        assert llama._head_axis(6) is None
+    gl.return_value.warning.assert_called_once()
+    assert "not divisible by tp" in gl.return_value.warning.call_args[0][0]
