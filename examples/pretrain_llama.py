@@ -70,7 +70,6 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu_devices)
 
-    import jax.numpy as jnp
     import numpy as np
 
     from neuronx_distributed_llama3_2_tpu.checkpoint import (
@@ -251,7 +250,10 @@ def main():
             )
         if (step + 1) % args.save_every == 0 and step + 1 < args.steps:
             save(step + 1)
-    save(args.steps)
+    # skip on a no-op resume: rewriting the completed final checkpoint would
+    # unmark done and risk losing it if killed mid-write
+    if start_step < args.steps:
+        save(args.steps)
     from neuronx_distributed_llama3_2_tpu.checkpoint import (
         finalize_async_saves,
     )

@@ -18,10 +18,6 @@ from typing import Any, Dict, Iterator, Optional
 
 import numpy as np
 
-from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
-
-logger = get_logger()
-
 
 class TokenDataset:
     """A flat token stream stored as one ``.npy`` array (any int dtype),

@@ -62,17 +62,15 @@ class LoraConfig:
 
 
 def _iter_targets(params: Params, patterns) -> Dict[str, jax.Array]:
-    """path -> leaf for every parameter matching a target regex."""
-    out = {}
+    """path -> leaf for every parameter matching a target regex (path
+    flattening shared with the checkpoint layer so both agree on keys)."""
+    from neuronx_distributed_llama3_2_tpu.checkpoint.checkpoint import _flatten
 
-    def visit(path, leaf):
-        key = "/".join(str(getattr(k, "key", k)) for k in path)
-        if any(re.search(p, key) for p in patterns):
-            out[key] = leaf
-        return leaf
-
-    jax.tree_util.tree_map_with_path(visit, params)
-    return out
+    return {
+        key: leaf
+        for key, leaf in _flatten(params).items()
+        if any(re.search(p, key) for p in patterns)
+    }
 
 
 def _split_shape(shape) -> Tuple[Tuple[int, ...], int, Tuple[int, ...]]:
@@ -155,8 +153,8 @@ class LoraModel:
 
         def visit(path, leaf):
             key = "/".join(str(getattr(k, "key", k)) for k in path)
-            if key in flat_targets and key in self._adapter_cache:
-                ab = self._adapter_cache[key]
+            if key in flat_targets and key in adapters:
+                ab = adapters[key]
                 a, b = ab["a"], ab["b"]
                 stack, fan_in, out_dims = _split_shape(leaf.shape)
                 if stack:
@@ -172,11 +170,7 @@ class LoraModel:
                 return leaf + (scale * delta).astype(leaf.dtype)
             return leaf
 
-        self._adapter_cache = adapters
-        try:
-            return jax.tree_util.tree_map_with_path(visit, self.base_params)
-        finally:
-            del self._adapter_cache
+        return jax.tree_util.tree_map_with_path(visit, self.base_params)
 
     def __call__(self, adapters: Params, input_ids: jax.Array) -> jax.Array:
         return self.base(self.merged_params(adapters), input_ids)

@@ -27,8 +27,6 @@ import dataclasses
 import math
 from typing import Any, Dict, Tuple
 
-import numpy as np
-
 from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
 
 logger = get_logger()

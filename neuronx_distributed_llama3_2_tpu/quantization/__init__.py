@@ -1,0 +1,33 @@
+from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
+    DEFAULT_TARGETS,
+    QuantizationConfig,
+    QuantizationType,
+    QuantizedTensor,
+    dequantize_params,
+    quantization_error,
+    quantize_array,
+    quantize_params,
+    quantize_specs,
+)
+from neuronx_distributed_llama3_2_tpu.quantization.layers import (
+    DEFAULT_QUANT_MODULE_MAPPINGS,
+    QuantizedColumnParallelLinear,
+    QuantizedRowParallelLinear,
+    convert,
+)
+
+__all__ = [
+    "DEFAULT_QUANT_MODULE_MAPPINGS",
+    "DEFAULT_TARGETS",
+    "QuantizationConfig",
+    "QuantizationType",
+    "QuantizedTensor",
+    "QuantizedColumnParallelLinear",
+    "QuantizedRowParallelLinear",
+    "convert",
+    "dequantize_params",
+    "quantization_error",
+    "quantize_array",
+    "quantize_params",
+    "quantize_specs",
+]

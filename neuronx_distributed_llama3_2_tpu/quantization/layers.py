@@ -1,0 +1,145 @@
+"""Quantized tensor-parallel linear layers.
+
+TPU-native replacement for the reference's ``QuantizedColumnParallel`` /
+``QuantizedRowParallel`` (quantization_layers.py:342,507) and the
+``from_float`` conversion entry points (:481,:635). The torch versions
+subclass the float parallel linears, re-register an int8 weight plus a scale
+buffer, and dequantize inside forward before the sharded matmul + hand-coded
+collective. Here the quantized layers are frozen dataclasses like every other
+layer in ``parallel/layers.py``: ``init`` produces a
+:class:`~..quantization.quantize.QuantizedTensor` kernel, ``specs`` shards the
+payload exactly like the float kernel and the scale along its channel axis
+(reference :165-211), and ``__call__`` dequantizes to the compute dtype — a
+multiply XLA fuses into the matmul, with the collectives still inserted by
+GSPMD from the same activation constraints the float layers use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    Params,
+    RowParallelLinear,
+)
+from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
+    QuantizationConfig,
+    QuantizedTensor,
+    quantize_array,
+    scale_spec,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizedColumnParallelLinear:
+    """reference QuantizedColumnParallel (quantization_layers.py:342)."""
+
+    inner: ColumnParallelLinear
+    q_config: QuantizationConfig = QuantizationConfig()
+    compute_dtype: Any = jnp.bfloat16
+
+    def init(self, key: jax.Array) -> Params:
+        return self.quantize_params(self.inner.init(key))
+
+    def quantize_params(self, params: Params) -> Params:
+        """Float params → quantized params (the weight-transfer step the
+        reference does in from_float, quantization_layers.py:481-506)."""
+        out = {"kernel": quantize_array(params["kernel"], self.q_config)}
+        if self.inner.use_bias:
+            out["bias"] = params["bias"]
+        return out
+
+    def specs(self) -> Params:
+        s = self.inner.specs()
+        out = {
+            "kernel": QuantizedTensor(
+                s["kernel"], scale_spec(s["kernel"], self.q_config, 2)
+            )
+        }
+        if self.inner.use_bias:
+            out["bias"] = s["bias"]
+        return out
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        float_params = dict(params)
+        float_params["kernel"] = params["kernel"].dequantize(self.compute_dtype)
+        return self.inner(float_params, x)
+
+    @classmethod
+    def from_float(
+        cls, mod: ColumnParallelLinear, q_config: QuantizationConfig = QuantizationConfig()
+    ) -> "QuantizedColumnParallelLinear":
+        """reference QuantizedColumnParallel.from_float (quantization_layers.py:481)."""
+        return cls(inner=mod, q_config=q_config, compute_dtype=mod.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizedRowParallelLinear:
+    """reference QuantizedRowParallel (quantization_layers.py:507).
+
+    Per-channel scales are along the *output* axis, which for a row-parallel
+    (in-sharded) kernel is replicated — so dequantize-then-matmul commutes
+    with the partial-sum all-reduce exactly as in the reference (:599-634).
+    """
+
+    inner: RowParallelLinear
+    q_config: QuantizationConfig = QuantizationConfig()
+    compute_dtype: Any = jnp.bfloat16
+
+    def init(self, key: jax.Array) -> Params:
+        return self.quantize_params(self.inner.init(key))
+
+    def quantize_params(self, params: Params) -> Params:
+        out = {"kernel": quantize_array(params["kernel"], self.q_config)}
+        if self.inner.use_bias:
+            out["bias"] = params["bias"]
+        return out
+
+    def specs(self) -> Params:
+        s = self.inner.specs()
+        out = {
+            "kernel": QuantizedTensor(
+                s["kernel"], scale_spec(s["kernel"], self.q_config, 2)
+            )
+        }
+        if self.inner.use_bias:
+            out["bias"] = s["bias"]
+        return out
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        float_params = dict(params)
+        float_params["kernel"] = params["kernel"].dequantize(self.compute_dtype)
+        return self.inner(float_params, x)
+
+    @classmethod
+    def from_float(
+        cls, mod: RowParallelLinear, q_config: QuantizationConfig = QuantizationConfig()
+    ) -> "QuantizedRowParallelLinear":
+        return cls(inner=mod, q_config=q_config, compute_dtype=mod.dtype)
+
+
+#: reference get_default_quant_module_mappings (quantization_mappings.py).
+DEFAULT_QUANT_MODULE_MAPPINGS = {
+    ColumnParallelLinear: QuantizedColumnParallelLinear,
+    RowParallelLinear: QuantizedRowParallelLinear,
+}
+
+
+def convert(
+    mod,
+    q_config: QuantizationConfig = QuantizationConfig(),
+    mapping=None,
+):
+    """Swap a float parallel linear for its quantized counterpart (reference
+    quantize.convert, quantize.py:13 — module-level; for whole param trees use
+    :func:`~..quantization.quantize.quantize_params`)."""
+    mapping = mapping or DEFAULT_QUANT_MODULE_MAPPINGS
+    qcls = mapping.get(type(mod))
+    if qcls is None:
+        raise TypeError(f"no quantized mapping for {type(mod).__name__}")
+    return qcls.from_float(mod, q_config)
