@@ -42,14 +42,17 @@ def flash_attention(
     attention across document boundaries (the segment-aware mode the NKI
     kernel lacks — long-context packing support).
 
-    Dispatch: the Pallas TPU kernel on TPU (segment-less path; custom fwd+bwd
-    kernels), else the pure-jax blockwise implementation."""
-    if segment_ids is None and jax.default_backend() == "tpu":
+    Dispatch: the Pallas TPU kernel on TPU (incl. segment-ids masking
+    in-kernel; custom fwd+bwd kernels), else the pure-jax blockwise
+    implementation."""
+    if jax.default_backend() == "tpu":
         from neuronx_distributed_llama3_2_tpu.kernels.pallas_flash_attention import (
             pallas_flash_attention,
         )
 
-        return pallas_flash_attention(q, k, v, causal=causal, block_kv=block_kv)
+        return pallas_flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, block_kv=block_kv
+        )
     return flash_attention_reference(
         q, k, v, causal=causal, segment_ids=segment_ids, block_kv=block_kv
     )

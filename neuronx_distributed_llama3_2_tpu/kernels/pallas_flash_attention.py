@@ -40,10 +40,16 @@ DEFAULT_BLOCK_KV = 256
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, causal: bool, sm_scale: float, block_q: int, block_kv: int,
-    kv_len: int,
+    *refs,
+    causal: bool, sm_scale: float, block_q: int, block_kv: int,
+    kv_len: int, segmented: bool,
 ):
+    if segmented:
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref,
+         o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        sq_ref = skv_ref = None
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -60,18 +66,27 @@ def _fwd_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (bq, D)
-        k = k_ref[0, 0].astype(jnp.float32)  # (bk, D)
+        # keep matmul operands in the input dtype (bf16): the MXU runs bf16
+        # at 4x its fp32 rate and accumulates in fp32 natively
+        # (preferred_element_type) — casting operands to fp32 here would
+        # quarter the kernel's flops ceiling. sm_scale is applied to the
+        # fp32 product instead of pre-scaling q, which is exact.
+        q = q_ref[0, 0]  # (bq, D)
+        k = k_ref[0, 0]  # (bk, D)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (bq, bk)
+        ) * sm_scale  # (bq, bk) fp32
 
         kv_pos = kv_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = kv_pos < kv_len
         if causal:
             q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             mask = mask & (kv_pos <= q_pos)
+        if segmented:
+            # packed-document masking: q attends only within its own segment
+            # (the jnp path's segment_ids semantics, flash_attention.py:47)
+            mask = mask & (sq_ref[0, :, 0][:, None] == skv_ref[0, :, 0][None, :])
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, 0]  # (bq,)
@@ -116,11 +131,21 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv):
+def _seg_operands(segment_ids, sq, skv, block_q, block_kv):
+    """(seg_q, seg_kv) padded to block multiples as (B, S_p, 1) int32; pad
+    ids are -1 so padded keys can never match a real segment."""
+    seg = segment_ids.astype(jnp.int32)
+    seg_q = jnp.pad(seg, ((0, 0), (0, -sq % block_q)), constant_values=-1)
+    seg_kv = jnp.pad(seg, ((0, 0), (0, -skv % block_kv)), constant_values=-1)
+    return seg_q[..., None], seg_kv[..., None]
+
+
+def _flash_fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_kv):
     """q (B, N, Sq, D), k/v (B, Nkv, Skv, D) → o (B, N, Sq, D), lse (B, N, Sq)."""
     b, n, sq, d = q.shape
     nkv, skv = k.shape[1], k.shape[2]
     group = n // nkv
+    segmented = segment_ids is not None
 
     qp = _pad_to(q, block_q, 2)
     kp = _pad_to(k, block_kv, 2)
@@ -143,15 +168,31 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv):
         block_q=block_q,
         block_kv=block_kv,
         kv_len=skv,
+        segmented=segmented,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_kv, d), kv_idx, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_kv, d), kv_idx, memory_space=pltpu.VMEM),
+    ]
+    operands = [qp, kp, vp]
+    if segmented:
+        seg_q, seg_kv = _seg_operands(segment_ids, sq, skv, block_q, block_kv)
+        in_specs += [
+            pl.BlockSpec(
+                (1, block_q, 1), lambda h, qi, ki: (h // n, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_kv, 1), lambda h, qi, ki: (h // n, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+        operands += [seg_q, seg_kv]
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), kv_idx, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_kv, d), kv_idx, memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM),
             # trailing singleton keeps the block's last-two-dims tiling legal
@@ -169,8 +210,14 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv):
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        # (batch·head, q-block) iterations are independent; only the kv dim
+        # carries the running-softmax scratch. Telling Mosaic unlocks
+        # cross-iteration pipelining it must otherwise assume away.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=_interpret(),
-    )(qp, kp, vp)
+    )(*operands)
     return o[:, :, :sq, :], lse[:, :, :sq, 0]
 
 
@@ -179,9 +226,14 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, causal, sm_scale, block_q, block_kv, kv_len,
+    *refs, causal, sm_scale, block_q, block_kv, kv_len, segmented,
 ):
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         sq_ref, skv_ref, dq_ref, dq_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+        sq_ref = skv_ref = None
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -194,27 +246,30 @@ def _bwd_dq_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * sm_scale
-        k = k_ref[0, 0].astype(jnp.float32)
+        # bf16 operands / fp32 accumulation on every dot (see _fwd_kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        ) * sm_scale
         kv_pos = kv_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = kv_pos < kv_len
         if causal:
             q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             mask = mask & (kv_pos <= q_pos)
+        if segmented:
+            mask = mask & (sq_ref[0, :, 0][:, None] == skv_ref[0, :, 0][None, :])
         lse = lse_ref[0, 0, :, 0]  # (bq,)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
-        do = do_ref[0, 0].astype(jnp.float32)  # (bq, D)
-        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0]  # (bq, D)
+        v = v_ref[0, 0]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (bq, bk)
         delta = delta_ref[0, 0, :, 0]  # (bq,)
-        ds = p * (dp - delta[:, None])  # (bq, bk)
+        ds = (p * (dp - delta[:, None])).astype(k.dtype)  # (bq, bk)
         dq_scr[:] += sm_scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -226,10 +281,15 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, causal, sm_scale, block_q, block_kv, kv_len, q_len,
+    *refs, causal, sm_scale, block_q, block_kv, kv_len, q_len, segmented,
 ):
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, skv_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        sq_ref = skv_ref = None
     ki, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -243,36 +303,38 @@ def _bwd_dkv_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * sm_scale
-        k = k_ref[0, 0].astype(jnp.float32)
+        # bf16 operands / fp32 accumulation on every dot (see _fwd_kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (bq, bk)
+        ) * sm_scale  # (bq, bk)
         kv_pos = kv_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         mask = (kv_pos < kv_len) & (q_pos < q_len)
         if causal:
             mask = mask & (kv_pos <= q_pos)
+        if segmented:
+            mask = mask & (sq_ref[0, :, 0][:, None] == skv_ref[0, :, 0][None, :])
         lse = lse_ref[0, 0, :, 0]
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # (bq, bk)
-        do = do_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0]
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (bk, D)
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         delta = delta_ref[0, 0, :, 0]
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
         dk_scr[:] += sm_scale * jax.lax.dot_general(
-            ds, q_ref[0, 0].astype(jnp.float32),
-            (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (bk, D); q_ref re-read unscaled — the sm_scale prefactor covers it
+        )  # (bk, D); q unscaled — the sm_scale prefactor covers it
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -280,10 +342,11 @@ def _bwd_dkv_kernel(
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv):
+def _flash_bwd(q, k, v, o, lse, do, segment_ids, causal, sm_scale, block_q, block_kv):
     b, n, sq, d = q.shape
     nkv, skv = k.shape[1], k.shape[2]
     group = n // nkv
+    segmented = segment_ids is not None
 
     delta = jnp.sum(
         o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
@@ -307,11 +370,30 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv):
     def kv_idx(h, i, j):
         return (h // n, (h % n) // group, j, 0)
 
+    seg_operands = []
+    if segmented:
+        seg_q, seg_kv = _seg_operands(segment_ids, sq, skv, block_q, block_kv)
+        seg_operands = [seg_q, seg_kv]
+
+    def seg_specs(q_block_dim: int):
+        # (seg_q, seg_kv) specs; q blocks iterate over grid dim q_block_dim
+        qdim = (lambda h, i, j: (h // n, i, 0)) if q_block_dim == 1 else (
+            lambda h, i, j: (h // n, j, 0)
+        )
+        kdim = (lambda h, i, j: (h // n, j, 0)) if q_block_dim == 1 else (
+            lambda h, i, j: (h // n, i, 0)
+        )
+        return [
+            pl.BlockSpec((1, block_q, 1), qdim, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_kv, 1), kdim, memory_space=pltpu.VMEM),
+        ]
+
     # dq: grid (BN, nq, nk)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_kv=block_kv, kv_len=skv,
+            segmented=segmented,
         ),
         grid=(b * n, nq_blk, nk_blk),
         in_specs=[
@@ -321,14 +403,17 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv):
             pl.BlockSpec((1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), q_vec_idx, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), q_vec_idx, memory_space=pltpu.VMEM),
-        ],
+        ] + (seg_specs(q_block_dim=1) if segmented else []),
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), q_idx, memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((b, n, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, *seg_operands)
 
     # dk/dv: grid (BN, nk, nq) — per q-head, then group-summed for GQA
     def kv_idx2(h, j, i):
@@ -347,6 +432,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv):
         functools.partial(
             _bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_kv=block_kv, kv_len=skv, q_len=sq,
+            segmented=segmented,
         ),
         grid=(b * n, nk_blk, nq_blk),
         in_specs=[
@@ -356,7 +442,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv):
             pl.BlockSpec((1, 1, block_q, d), q_idx2, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), q_vec_idx2, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), q_vec_idx2, memory_space=pltpu.VMEM),
-        ],
+        ] + (seg_specs(q_block_dim=2) if segmented else []),
         out_specs=[
             pl.BlockSpec((1, 1, block_kv, d), dkv_idx, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_kv, d), dkv_idx, memory_space=pltpu.VMEM),
@@ -369,8 +455,11 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv):
             pltpu.VMEM((block_kv, d), jnp.float32),
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, *seg_operands)
 
     # GQA: sum q-head contributions within each kv group
     dk = dk_ph[:, :, :skv, :].reshape(b, nkv, group, skv, d).sum(axis=2)
@@ -382,23 +471,23 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv):
 # public op with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bnsd(q, k, v, causal, sm_scale, block_q, block_kv):
-    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_bnsd(q, k, v, segment_ids, causal, sm_scale, block_q, block_kv):
+    o, _ = _flash_fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_kv)
     return o
 
 
-def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_kv):
-    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv)
-    return o, (q, k, v, o, lse)
+def _fwd_rule(q, k, v, segment_ids, causal, sm_scale, block_q, block_kv):
+    o, lse = _flash_fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_kv)
+    return o, (q, k, v, segment_ids, o, lse)
 
 
 def _bwd_rule(causal, sm_scale, block_q, block_kv, res, do):
-    q, k, v, o, lse = res
+    q, k, v, segment_ids, o, lse = res
     dq, dk, dv = _flash_bwd(
-        q, k, v, o, lse, do, causal, sm_scale, block_q, block_kv
+        q, k, v, o, lse, do, segment_ids, causal, sm_scale, block_q, block_kv
     )
-    return dq, dk, dv
+    return dq, dk, dv, None
 
 
 _flash_attention_bnsd.defvjp(_fwd_rule, _bwd_rule)
@@ -409,16 +498,19 @@ def pallas_flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
+    segment_ids: "jax.Array | None" = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_kv: int = DEFAULT_BLOCK_KV,
 ) -> jax.Array:
     """(B, S, N, D) layout entry point matching
-    :func:`..kernels.flash_attention.flash_attention`."""
+    :func:`..kernels.flash_attention.flash_attention`. ``segment_ids``
+    (B, S) int: packed-document masking in-kernel (the NKI reference kernel
+    has no segment support, kernels/flash_attn.py — this beats it)."""
     sm_scale = q.shape[-1] ** -0.5
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     o = _flash_attention_bnsd(
-        qt, kt, vt, causal, sm_scale, block_q, block_kv
+        qt, kt, vt, segment_ids, causal, sm_scale, block_q, block_kv
     )
     return o.transpose(0, 2, 1, 3)

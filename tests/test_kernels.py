@@ -84,6 +84,47 @@ def test_pallas_unaligned_seq():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_pallas_segments_match_reference(causal):
+    """Packed-document masking in-kernel (VERDICT #9): Pallas path parity
+    with the jnp segment implementation, unaligned doc boundaries."""
+    q, k, v = _qkv(s=200)
+    seg = jnp.asarray(
+        np.repeat([0, 1, 2], [70, 60, 70])[None, :], jnp.int32
+    )
+    ref = flash_attention_reference(
+        q, k, v, causal=causal, segment_ids=seg, block_kv=64
+    )
+    out = pallas_flash_attention(
+        q, k, v, causal=causal, segment_ids=seg, block_q=128, block_kv=128
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_pallas_segments_backward():
+    q, k, v = _qkv(s=128)
+    seg = jnp.concatenate(
+        [jnp.zeros((1, 64), jnp.int32), jnp.ones((1, 64), jnp.int32)], axis=1
+    )
+
+    def lp(q, k, v):
+        return (
+            pallas_flash_attention(
+                q, k, v, segment_ids=seg, block_q=64, block_kv=64
+            ) ** 2
+        ).sum()
+
+    def lr(q, k, v):
+        return (
+            flash_attention_reference(q, k, v, segment_ids=seg, block_kv=64) ** 2
+        ).sum()
+
+    gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
 def test_chunked_ce_matches_full():
     model = LlamaForCausalLM(TINY)
     params = model.init(jax.random.key(0))
