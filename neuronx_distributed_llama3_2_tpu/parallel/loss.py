@@ -89,6 +89,12 @@ def parallel_cross_entropy(
         return cross_entropy(logits, labels, label_smoothing)
 
     mesh = parallel_state.get_parallel_state().mesh
+    # inside a partial-manual region (e.g. the 1F1B executor, manual over pp)
+    # the nested shard_map must be built against the ambient abstract mesh,
+    # whose manual axes are marked (same rule as layers.constrain)
+    ambient = jax.sharding.get_abstract_mesh()
+    if ambient is not None and not ambient.empty:
+        mesh = ambient
     nd = logits.ndim
     # leading dim rides the data-parallel axes so dp-sharded logits enter the
     # shard_map without an all-gather (each dp shard computes only its rows);

@@ -8,12 +8,13 @@ recv-bwd-before-send-fwd deadlock-avoidance ordering :227-233). Like the
 reference's, this module is hardware-free and unit-testable in isolation
 (SURVEY.md §4 — scheduler equivalence tests).
 
-Role on TPU: the SPMD executor (:mod:`.model`) compiles a GPipe-equivalent
-schedule directly into one XLA program, where XLA's static scheduling replaces
-task lists. These task lists remain the *specification* used by the tests to
-validate the executor's timing (bubble count, per-stage utilization) and are
-the contract for a future multi-controller runtime where stages are separate
-programs.
+Role on TPU: the SPMD executors (:mod:`.model`) compile these schedules into
+one XLA program each — ``schedule="gpipe"`` realizes
+:class:`TrainGPipeSchedule` (fwd scan + autodiff backward),
+``schedule="1f1b"`` realizes :class:`Train1F1BSchedule`'s per-stage timing
+(warmup pp-1-s, steady alternating fwd/bwd, cooldown) via
+``PipelinedCausalLM.loss_and_grad``. The task lists stay the hardware-free
+*specification* the tests validate both executors against.
 """
 
 from __future__ import annotations

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.layers import constrain
 from neuronx_distributed_llama3_2_tpu.parallel.state import DP_AXIS, EP_AXIS
 from neuronx_distributed_llama3_2_tpu.trainer.config import TrainingConfig
 from neuronx_distributed_llama3_2_tpu.trainer.optimizer import (
@@ -116,7 +117,13 @@ def make_train_step(
     def loss_fn(params, input_ids, labels):
         return model.loss(params, input_ids, labels)
 
-    grad_fn = jax.value_and_grad(loss_fn)
+    # a model exposing loss_and_grad computes its own gradients (the 1F1B
+    # pipeline interleaves fwd/bwd manually — autodiff can't express its
+    # schedule); otherwise differentiate the loss
+    if hasattr(model, "loss_and_grad") and getattr(model, "schedule", None) == "1f1b":
+        grad_fn = lambda p, ids, lbl: model.loss_and_grad(p, ids, lbl)  # noqa: E731
+    else:
+        grad_fn = jax.value_and_grad(loss_fn)
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, dict]:
         input_ids, labels = batch["input_ids"], batch["labels"]
@@ -191,6 +198,17 @@ def make_train_step(
             state.params,
             opt_cfg,
             weight_decay_mask=default_weight_decay_mask(state.params),
+        )
+        # pin the output state to its canonical specs: keeps shardings
+        # identical step over step (no drift-induced recompiles) and gives
+        # XLA's partitioner an anchor when grads come out of manual shard_map
+        # regions (the 1F1B executor + ZeRO combination trips a partitioner
+        # CHECK without this)
+        pspecs = model.specs()
+        new_params = jax.tree.map(constrain, new_params, pspecs)
+        new_opt = jax.tree.map(
+            constrain, new_opt,
+            optimizer_state_specs(pspecs, state.params, opt_cfg),
         )
         metrics = {
             "loss": loss.astype(jnp.float32),

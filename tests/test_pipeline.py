@@ -184,3 +184,89 @@ def test_pipeline_training_with_trainer():
         state, metrics = step(state, batch)
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0] - 0.3, losses
+
+
+# ---------------------------------------------------------------------------
+# 1F1B executor (manual-VJP schedule, VERDICT #5)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pp,M,tp", [(2, 4, 1), (4, 4, 2)])
+def test_1f1b_loss_and_grad_matches_autodiff(pp, M, tp):
+    """1F1B's manually-scheduled backward == jax.grad of the unpipelined
+    model (the reference's 1F1B-vs-GPipe equivalence, scheduler tests +
+    llama2_70B_4layers_PP parity)."""
+    model = LlamaForCausalLM(TINY)
+    params = model.init(jax.random.key(4))
+    ids = _mk_batch(gbs=8, seq=16)
+    ref_loss, ref_grads = jax.jit(jax.value_and_grad(model.loss))(params, ids, ids)
+
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp
+    )
+    pmodel = PipelinedCausalLM(model, num_microbatches=M, schedule="1f1b")
+    pp_params = shard_pytree(pmodel.to_pipeline(params), pmodel.specs())
+    loss, grads = jax.jit(pmodel.loss_and_grad)(pp_params, ids, ids)
+    assert abs(float(loss) - float(ref_loss)) < 1e-4
+    from neuronx_distributed_llama3_2_tpu.checkpoint.checkpoint import _flatten
+
+    flat_ref = _flatten(ref_grads)
+    flat_got = _flatten(pmodel.from_pipeline(grads))
+    assert set(flat_ref) == set(flat_got)
+    for key in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(flat_ref[key], np.float32),
+            np.asarray(flat_got[key], np.float32),
+            atol=5e-4, rtol=1e-3, err_msg=key,
+        )
+
+
+def test_1f1b_through_trainer():
+    """schedule='1f1b' trains via the trainer facade (loss_and_grad path)."""
+    cfg = TrainingConfig(
+        pipeline_parallel_size=2,
+        num_microbatches=1,
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=1),
+    )
+    cfg.initialize()
+    model = LlamaForCausalLM(TINY)
+    pmodel = PipelinedCausalLM(model, num_microbatches=2, schedule="1f1b")
+    state, _ = initialize_parallel_model(pmodel, cfg)
+    step = make_train_step(pmodel, cfg)
+    ids = _mk_batch(gbs=4, seq=16)
+    losses = []
+    for _ in range(5):
+        state, m = step(state, {"input_ids": ids, "labels": ids})
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_1f1b_activation_memory_below_gpipe():
+    """The point of 1F1B (VERDICT #5 done-condition): peak temp memory under
+    the manual schedule stays below GPipe's autodiff-stored streams once M
+    outgrows pp (measured via XLA's compiled memory analysis; at
+    M=32,S=2048,H=256,pp=4 this is ~284MB vs ~480MB, and the 1F1B side is
+    M-independent)."""
+    cfg = dataclasses.replace(
+        TINY, num_layers=4, remat="full", hidden_size=256, num_heads=4,
+        num_kv_heads=2, intermediate_size=1024, max_seq_len=2048,
+    )
+    parallel_state.initialize_model_parallel(pipeline_model_parallel_size=4)
+    model = LlamaForCausalLM(cfg)
+    M = 32
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (M, 2048)),
+        jnp.int32,
+    )
+    temps = {}
+    for sched in ["gpipe", "1f1b"]:
+        pm = PipelinedCausalLM(model, num_microbatches=M, schedule=sched)
+        params = shard_pytree(pm.to_pipeline(model.init(jax.random.key(0))), pm.specs())
+        fn = (
+            jax.jit(jax.value_and_grad(pm.loss))
+            if sched == "gpipe"
+            else jax.jit(pm.loss_and_grad)
+        )
+        ma = fn.lower(params, ids, ids).compile().memory_analysis()
+        temps[sched] = ma.temp_size_in_bytes
+    assert temps["1f1b"] < 0.8 * temps["gpipe"], temps
