@@ -23,6 +23,7 @@ gap on shape-locked pipelined saves).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import os
 import sys
@@ -54,6 +55,14 @@ def parse_args():
     p.add_argument("--async-save", action="store_true")
     p.add_argument("--keep-ckpts", type=int, default=3)
     p.add_argument("--metrics-file", default=None)
+    p.add_argument(
+        "--timeline", default=None,
+        help="write a Chrome-trace host timeline (events: step/data/ckpt)",
+    )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture an XLA device trace of steps 2-4 into this dir",
+    )
     p.add_argument("--seed", type=int, default=42)
     p.add_argument(
         "--cpu-devices", type=int, default=0,
@@ -227,12 +236,39 @@ def main():
             num_kept_ckpts=args.keep_ckpts,
         )
 
+    from neuronx_distributed_llama3_2_tpu.utils.profiler import (
+        Timeline,
+        device_trace,
+        step_annotation,
+    )
+
+    timeline = Timeline(args.timeline)
+    profile_ctx = None
+
+    def stop_profile():
+        nonlocal profile_ctx
+        if profile_ctx is not None:
+            profile_ctx.__exit__(None, None, None)
+            profile_ctx = None
+
+    # always stop the trace, even when the run ends (or raises) inside the
+    # profiling window — an unstopped trace is never flushed to disk
+    import atexit
+
+    atexit.register(stop_profile)
     for step in range(start_step, args.steps):
-        batch = next(batches)
-        ids = batch_to_device(batch, mesh)
+        if args.profile_dir and step == start_step + 2:
+            profile_ctx = device_trace(args.profile_dir)
+            profile_ctx.__enter__()
+        with timeline.event("load_batch", cat="data"):
+            batch = next(batches)
+            ids = batch_to_device(batch, mesh)
         t0 = time.perf_counter()
-        state, m = step_fn(state, {"input_ids": ids, "labels": ids})
-        loss = float(m["loss"])  # blocks until the step finished
+        with timeline.event("train_step", cat="step"), step_annotation(step):
+            state, m = step_fn(state, {"input_ids": ids, "labels": ids})
+            loss = float(m["loss"])  # blocks until the step finished
+        if args.profile_dir and step == start_step + 4:
+            stop_profile()
         if not np.isfinite(loss):
             raise RuntimeError(f"non-finite loss {loss} at step {step}")
         seqs_per_s = throughput.tick()
@@ -249,11 +285,14 @@ def main():
                 seqs_per_s=seqs_per_s,
             )
         if (step + 1) % args.save_every == 0 and step + 1 < args.steps:
-            save(step + 1)
+            with timeline.event("save_checkpoint", cat="ckpt", step=step + 1):
+                save(step + 1)
+        timeline.step_end(step)
     # skip on a no-op resume: rewriting the completed final checkpoint would
     # unmark done and risk losing it if killed mid-write
     if start_step < args.steps:
         save(args.steps)
+    timeline.close()
     from neuronx_distributed_llama3_2_tpu.checkpoint import (
         finalize_async_saves,
     )

@@ -1,0 +1,197 @@
+"""Profiling & timeline observability.
+
+TPU-native replacement for the reference's tracing subsystem:
+``utils/timeline.py:14`` (``Timeline``: mark_event_start/end per rank,
+mark_step_end dumps one JSON record per step) and ``pipeline/timeline.py:10``
+(``PPTimeline``: per-pp-rank event collection over the torch distributed
+store), plus the neuron-profile hooks the reference reaches via torch-xla.
+
+Redesign for the JAX stack, two complementary layers:
+
+1. :class:`Timeline` — host-side event timeline in **Chrome trace format**
+   (the ``chrome://tracing`` / Perfetto JSON array), replacing the reference's
+   ad-hoc JSON records. Events carry a ``cat`` (category) instead of the
+   reference's pp-rank — under SPMD one process drives the whole mesh, so
+   "rank lanes" become category lanes (step / data / checkpoint / compile).
+   Thread-safe; events buffer in memory and flush on ``step_end``/``close``
+   like the reference's per-step dump (timeline.py:62-90).
+
+2. :func:`device_trace` / :func:`annotate` — thin wrappers over
+   ``jax.profiler``: XLA device-level traces viewable in
+   TensorBoard/Perfetto/XProf, the analogue of the reference's neuron-profile
+   NTFF captures. ``annotate`` nests named regions into the device trace
+   (``jax.profiler.TraceAnnotation``) so train-step phases are attributable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+@dataclasses.dataclass
+class _Event:
+    name: str
+    cat: str
+    start_us: float
+    dur_us: float
+    args: Optional[Dict[str, Any]] = None
+
+
+class Timeline:
+    """Chrome-trace host-event timeline (reference Timeline, utils/timeline.py:14).
+
+    Usage::
+
+        tl = Timeline("/tmp/run/timeline.json")
+        with tl.event("load_batch", cat="data"):
+            ...
+        tl.mark_event_start("step")       # explicit mark API, like the
+        tl.mark_event_end("step")         # reference's (timeline.py:43-58)
+        tl.step_end(step=i)               # flush, advance step counter
+        tl.close()
+
+    A ``trace_file_path`` of None disables all recording (reference
+    timeline.py:36-38), so call sites need no guards.
+    """
+
+    def __init__(self, trace_file_path: Optional[str]):
+        self.enabled = trace_file_path is not None
+        self.path = trace_file_path
+        self.step = 0
+        self._open: Dict[str, float] = {}
+        self._events: List[_Event] = []
+        self._lanes: Dict[str, int] = {}  # category -> tid, stable across flushes
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter_ns()
+        if self.enabled:
+            os.makedirs(os.path.dirname(os.path.abspath(trace_file_path)), exist_ok=True)
+            # timestamps are relative to this process's start: appending to a
+            # previous run's file would interleave two runs on the same lanes
+            if os.path.exists(trace_file_path):
+                os.remove(trace_file_path)
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def mark_event_start(self, label: str, cat: str = "step") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            if label in self._open:
+                raise ValueError(f"event {label!r} already started")
+            self._open[label] = self._now_us()
+
+    def mark_event_end(self, label: str, cat: str = "step", **args) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            start = self._open.pop(label, None)
+            if start is None:
+                raise ValueError(f"event {label!r} was never started")
+            self._events.append(
+                _Event(label, cat, start, self._now_us() - start, args or None)
+            )
+
+    @contextlib.contextmanager
+    def event(self, label: str, cat: str = "step", **args):
+        self.mark_event_start(label, cat)
+        try:
+            yield
+        finally:
+            self.mark_event_end(label, cat, **args)
+
+    def step_end(self, step: Optional[int] = None, flush: bool = True) -> None:
+        """Advance the step counter and (by default) flush to disk — the
+        reference dumps per step too (mark_step_end, timeline.py:62)."""
+        self.step = self.step + 1 if step is None else step + 1
+        if flush:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            events, self._events = self._events, []
+        if not events:
+            return
+        # chrome trace "X" (complete) events; pid 0, tid = category lane
+        records = []
+        for e in events:
+            tid = self._lanes.setdefault(e.cat, len(self._lanes))
+            rec = {
+                "name": e.name,
+                "cat": e.cat,
+                "ph": "X",
+                "ts": round(e.start_us, 3),
+                "dur": round(e.dur_us, 3),
+                "pid": 0,
+                "tid": tid,
+            }
+            if e.args:
+                rec["args"] = e.args
+            records.append(rec)
+        new = ",\n".join(json.dumps(r) for r in records)
+        # maintain a valid JSON array in-place across incremental flushes
+        with self._lock:
+            exists = os.path.exists(self.path) and os.path.getsize(self.path) > 2
+            if not exists:
+                with open(self.path, "w") as f:
+                    f.write("[\n" + new + "\n]")
+            else:
+                with open(self.path, "rb+") as f:
+                    f.seek(-2, os.SEEK_END)  # drop trailing "\n]"
+                    f.truncate()
+                    f.write((",\n" + new + "\n]").encode())
+
+    def close(self) -> None:
+        with self._lock:
+            for label, start in list(self._open.items()):
+                self._events.append(_Event(label, "step", start, self._now_us() - start))
+            self._open.clear()
+        self.flush()
+
+
+# ---------------------------------------------------------------------------
+# device-level (XLA) profiling
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def device_trace(logdir: str, host_tracer_level: int = 2):
+    """Capture an XLA device trace into ``logdir`` (TensorBoard / XProf /
+    Perfetto readable). The analogue of the reference's neuron-profile
+    capture; wrap a handful of steady-state steps, not the whole run::
+
+        with device_trace("/tmp/profile"):
+            for _ in range(3):
+                state, m = step(state, data)
+            jax.block_until_ready(m)
+    """
+    logger.info("profiling to %s", logdir)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profile written to %s", logdir)
+
+
+def annotate(name: str, **kwargs):
+    """Named region inside a device trace (jax.profiler.TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name, **kwargs)
+
+
+def step_annotation(step: int):
+    """Mark a train step for the profiler's step-time view."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)

@@ -1,0 +1,81 @@
+"""Timeline / profiling tests (reference utils/timeline.py semantics: paired
+start/end marks, per-step dump, disabled-when-no-path; VERDICT missing #8)."""
+
+import json
+
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.utils.profiler import Timeline, annotate
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_timeline_chrome_trace_roundtrip(tmp_path):
+    p = tmp_path / "tl.json"
+    tl = Timeline(str(p))
+    with tl.event("load_batch", cat="data"):
+        pass
+    tl.mark_event_start("train_step")
+    tl.mark_event_end("train_step", loss=1.5)
+    tl.step_end(0)
+    with tl.event("save", cat="ckpt"):
+        pass
+    tl.close()
+    events = _load(p)
+    names = [e["name"] for e in events]
+    assert names == ["load_batch", "train_step", "save"]
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+    # categories map to distinct lanes
+    tids = {e["cat"]: e["tid"] for e in events}
+    assert len(set(tids.values())) == 3
+    # args pass through
+    assert events[1]["args"] == {"loss": 1.5}
+
+
+def test_timeline_incremental_flush_stays_valid_json(tmp_path):
+    p = tmp_path / "tl.json"
+    tl = Timeline(str(p))
+    for i in range(3):
+        with tl.event("step"):
+            pass
+        tl.step_end(i)  # flush per step (reference mark_step_end)
+        assert len(_load(p)) == i + 1
+    tl.close()
+
+
+def test_timeline_disabled_without_path():
+    tl = Timeline(None)
+    with tl.event("x"):
+        pass
+    tl.mark_event_start("y")
+    tl.mark_event_end("y")
+    tl.step_end()
+    tl.close()  # no file io, no error
+
+
+def test_timeline_unbalanced_marks_raise(tmp_path):
+    tl = Timeline(str(tmp_path / "t.json"))
+    tl.mark_event_start("a")
+    with pytest.raises(ValueError):
+        tl.mark_event_start("a")  # duplicate start (reference asserts too)
+    with pytest.raises(ValueError):
+        tl.mark_event_end("never-started")
+
+
+def test_timeline_close_flushes_open_events(tmp_path):
+    p = tmp_path / "t.json"
+    tl = Timeline(str(p))
+    tl.mark_event_start("dangling")
+    tl.close()
+    assert [e["name"] for e in _load(p)] == ["dangling"]
+
+
+def test_annotate_usable_under_trace():
+    # TraceAnnotation is a no-op outside an active profiler session; it must
+    # still nest cleanly so call sites need no guards
+    with annotate("region"):
+        with annotate("inner"):
+            pass
