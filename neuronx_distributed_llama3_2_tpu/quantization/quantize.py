@@ -129,7 +129,12 @@ def quantize_array(
         scale = scale.reshape((1,) * wf.ndim)
     else:
         axis = config.per_channel_axis % wf.ndim
-        reduce_axes = tuple(i for i in range(wf.ndim) if i != axis)
+        # kernels of rank >= 3 are layer-stacked (L, ..., out): keep a scale
+        # per (layer, channel) so depth-wise magnitude variation between
+        # layers doesn't let one layer's absmax wash out another's precision
+        # (the reference quantizes per-layer modules, so it gets this free)
+        keep = {axis} | ({0} if wf.ndim >= 3 else set())
+        reduce_axes = tuple(i for i in range(wf.ndim) if i not in keep)
         absmax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
         scale = jnp.maximum(absmax / qmax, 1e-12)
     q = wf / scale
@@ -147,8 +152,9 @@ def scale_spec(kernel_spec: P, config: QuantizationConfig, ndim: int) -> P:
     if config.quantization_type is QuantizationType.PER_TENSOR_SYMMETRIC:
         return P(*((None,) * ndim))
     axis = config.per_channel_axis % ndim
+    keep = {axis} | ({0} if ndim >= 3 else set())  # mirror quantize_array
     entries = list(kernel_spec) + [None] * (ndim - len(list(kernel_spec)))
-    return P(*[entries[i] if i == axis else None for i in range(ndim)])
+    return P(*[entries[i] if i in keep else None for i in range(ndim)])
 
 
 # ---------------------------------------------------------------------------

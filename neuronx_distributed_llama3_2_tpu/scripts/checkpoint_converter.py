@@ -1,0 +1,196 @@
+"""Checkpoint converter CLI: HF ↔ native, both directions, offline.
+
+TPU-native replacement for the reference's converter tooling:
+``scripts/checkpoint_converter.py:238`` (``merge_tp_checkpoints``: per-rank
+TP shards → full HF state dict), ``:393`` (``convert_full_state_to_tp``:
+full → per-rank shards) and ``optimizer/convert_zero_checkpoints.py:176``
+(merge/split dp-sharded ZeRO optimizer states).
+
+Under GSPMD most of that machinery dissolves: native checkpoints hold
+*global* arrays (checkpoint/checkpoint.py), so there are no per-rank shards
+to merge/split — resharding happens online at load via specs (elastic
+resume, tested in test_checkpoint.py). What remains meaningful offline, and
+what this CLI does:
+
+- ``hf-to-native``: read an HF Llama checkpoint directory (safetensors or
+  pytorch .bin) → write a native checkpoint tag loadable by
+  ``load_checkpoint`` at any tp/pp/dp.
+- ``native-to-hf``: read a native tag → write HF-format safetensors +
+  config.json, loadable by ``transformers``.
+- ``strip-optimizer``: rewrite a training checkpoint keeping only model
+  weights (the role of the reference's optimizer-state merge for export:
+  once merged the optimizer state is dropped for serving).
+
+Usage::
+
+    python -m neuronx_distributed_llama3_2_tpu.scripts.checkpoint_converter \
+        --direction hf-to-native --model llama3.2-1b \
+        --input /path/hf_dir --output /path/ckpt_dir --tag from_hf
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict
+
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+def load_hf_state_dict(path: str) -> Dict[str, Any]:
+    """Read every *.safetensors (preferred) or pytorch_model*.bin in ``path``
+    into one numpy state dict."""
+    import numpy as np
+
+    sd: Dict[str, Any] = {}
+    st_files = sorted(
+        f for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors.numpy import load_file
+
+        for f in st_files:
+            sd.update(load_file(os.path.join(path, f)))
+        return sd
+    bin_files = sorted(
+        f
+        for f in os.listdir(path)
+        if f.startswith("pytorch_model") and f.endswith(".bin")
+    )
+    if not bin_files:
+        raise FileNotFoundError(
+            f"no *.safetensors or pytorch_model*.bin under {path}"
+        )
+    import torch
+
+    for f in bin_files:
+        t = torch.load(os.path.join(path, f), map_location="cpu", weights_only=True)
+        sd.update({k: v.float().numpy() for k, v in t.items()})
+    return sd
+
+
+def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
+    """Write a safetensors HF checkpoint + minimal config.json."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    save_file(
+        {k: np.ascontiguousarray(v) for k, v in sd.items()},
+        os.path.join(path, "model.safetensors"),
+    )
+    cfg = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_layers,
+        "num_attention_heads": config.num_heads,
+        "num_key_value_heads": config.num_kv_heads,
+        "vocab_size": config.vocab_size,
+        "rms_norm_eps": config.rms_norm_eps,
+        "rope_theta": config.rope_theta,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "max_position_embeddings": config.max_seq_len,
+        # tensors are exported fp32 (params_to_hf)
+        "torch_dtype": "float32",
+    }
+    if config.rope_scaling is not None:
+        # HF "llama3" rope scaling dict — omitting it would silently load
+        # published Llama-3.2 weights with unscaled RoPE (review finding)
+        factor, low, high, orig = config.rope_scaling
+        cfg["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": factor,
+            "low_freq_factor": low,
+            "high_freq_factor": high,
+            "original_max_position_embeddings": orig,
+        }
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg, f, indent=2)
+
+
+def hf_to_native(args) -> None:
+    from neuronx_distributed_llama3_2_tpu.checkpoint import save_checkpoint
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        params_from_hf,
+    )
+
+    config = LLAMA_CONFIGS[args.model]
+    sd = load_hf_state_dict(args.input)
+    params = params_from_hf(sd, config)
+    save_checkpoint(args.output, tag=args.tag, model=params)
+    logger.info("wrote native checkpoint %s/%s", args.output, args.tag)
+
+
+def native_to_hf(args) -> None:
+    from neuronx_distributed_llama3_2_tpu.checkpoint import load_checkpoint
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+        params_to_hf,
+    )
+
+    import jax
+
+    config = LLAMA_CONFIGS[args.model]
+    template = jax.eval_shape(LlamaForCausalLM(config).init, jax.random.key(0))
+    loaded = load_checkpoint(args.input, tag=args.tag, model=template)
+    if loaded is None:
+        raise FileNotFoundError(f"no checkpoint tag {args.tag} under {args.input}")
+    sd = params_to_hf(loaded["model"], config)
+    save_hf_state_dict(sd, args.output, config)
+    logger.info("wrote HF checkpoint to %s", args.output)
+
+
+def strip_optimizer(args) -> None:
+    from neuronx_distributed_llama3_2_tpu.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+    )
+
+    import jax
+
+    config = LLAMA_CONFIGS[args.model]
+    template = jax.eval_shape(LlamaForCausalLM(config).init, jax.random.key(0))
+    loaded = load_checkpoint(args.input, tag=args.tag, model=template)
+    if loaded is None:
+        raise FileNotFoundError(f"no checkpoint tag {args.tag} under {args.input}")
+    save_checkpoint(
+        args.output, tag=args.out_tag or args.tag, model=loaded["model"]
+    )
+    logger.info(
+        "wrote model-only checkpoint %s/%s", args.output, args.out_tag or args.tag
+    )
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument(
+        "--direction",
+        required=True,
+        choices=["hf-to-native", "native-to-hf", "strip-optimizer"],
+    )
+    p.add_argument("--model", required=True, help="LLAMA_CONFIGS key")
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--tag", default="latest", help="native checkpoint tag")
+    p.add_argument("--out-tag", default=None)
+    args = p.parse_args(argv)
+    {
+        "hf-to-native": hf_to_native,
+        "native-to-hf": native_to_hf,
+        "strip-optimizer": strip_optimizer,
+    }[args.direction](args)
+
+
+if __name__ == "__main__":
+    main()

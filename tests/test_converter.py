@@ -1,0 +1,99 @@
+"""Checkpoint converter tests (VERDICT missing #7): HF↔native roundtrips and
+the CLI entry points (reference scripts/checkpoint_converter.py:238,393)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LLAMA_CONFIGS,
+    LlamaForCausalLM,
+    params_from_hf,
+    params_to_hf,
+)
+from neuronx_distributed_llama3_2_tpu.scripts.checkpoint_converter import main as cli
+
+TINY = LLAMA_CONFIGS["tiny"]
+
+
+def _tiny_params():
+    return LlamaForCausalLM(TINY).init(jax.random.key(0))
+
+
+def test_hf_roundtrip_exact():
+    """params → HF state dict → params is the identity (fp32 tiny)."""
+    params = _tiny_params()
+    back = params_from_hf(params_to_hf(params, TINY), TINY)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32), np.asarray(b, np.float32))
+
+
+def test_hf_state_dict_names_match_transformers_convention():
+    sd = params_to_hf(_tiny_params(), TINY)
+    assert "model.embed_tokens.weight" in sd
+    assert "model.layers.0.self_attn.q_proj.weight" in sd
+    assert "model.layers.0.mlp.gate_proj.weight" in sd
+    assert "model.norm.weight" in sd
+    # tiny ties embeddings: no lm_head in the exported dict (HF convention)
+    assert ("lm_head.weight" in sd) == (not TINY.tie_word_embeddings)
+    # torch Linear layout (out, in)
+    assert sd["model.layers.0.mlp.gate_proj.weight"].shape == (
+        TINY.intermediate_size,
+        TINY.hidden_size,
+    )
+
+
+def test_cli_hf_to_native_to_hf(tmp_path):
+    from safetensors.numpy import save_file
+
+    params = _tiny_params()
+    sd = params_to_hf(params, TINY)
+    hf_dir = tmp_path / "hf"
+    hf_dir.mkdir()
+    save_file({k: np.ascontiguousarray(v) for k, v in sd.items()},
+              str(hf_dir / "model.safetensors"))
+
+    ckpt_dir = tmp_path / "native"
+    cli([
+        "--direction", "hf-to-native", "--model", "tiny",
+        "--input", str(hf_dir), "--output", str(ckpt_dir), "--tag", "imported",
+    ])
+    assert (ckpt_dir / "imported" / "done").exists()
+
+    out_dir = tmp_path / "hf_back"
+    cli([
+        "--direction", "native-to-hf", "--model", "tiny",
+        "--input", str(ckpt_dir), "--output", str(out_dir), "--tag", "imported",
+    ])
+    from safetensors.numpy import load_file
+
+    back = load_file(str(out_dir / "model.safetensors"))
+    assert set(back) == set(sd)
+    for k in sd:
+        np.testing.assert_allclose(back[k], np.asarray(sd[k], np.float32), atol=1e-6)
+    assert (out_dir / "config.json").exists()
+
+
+def test_cli_strip_optimizer(tmp_path):
+    from neuronx_distributed_llama3_2_tpu.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    params = _tiny_params()
+    fake_opt = {"m": jax.tree.map(jnp.zeros_like, params)}
+    src = tmp_path / "train"
+    save_checkpoint(str(src), tag="step_5", model=params, optimizer=fake_opt)
+    dst = tmp_path / "export"
+    cli([
+        "--direction", "strip-optimizer", "--model", "tiny",
+        "--input", str(src), "--output", str(dst), "--tag", "step_5",
+    ])
+    template = jax.eval_shape(LlamaForCausalLM(TINY).init, jax.random.key(0))
+    loaded = load_checkpoint(str(dst), tag="step_5", model=template)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded["model"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # optimizer state not carried over
+    with pytest.raises(Exception):
+        load_checkpoint(str(dst), tag="step_5", optimizer=fake_opt)

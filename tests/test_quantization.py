@@ -81,6 +81,21 @@ def test_fp8_roundtrip():
     )
 
 
+def test_stacked_kernels_get_per_layer_scales():
+    """(L, in, out) stacks must not share scales across L: a layer with
+    100x-smaller weights keeps its own precision (review finding)."""
+    w = jnp.stack([
+        jax.random.normal(jax.random.key(0), (8, 16)) * 0.01,
+        jax.random.normal(jax.random.key(1), (8, 16)),
+        jax.random.normal(jax.random.key(2), (8, 16)),
+    ])
+    qt = quantize_array(w)
+    assert qt.scale.shape == (3, 1, 16)
+    err0 = float(jnp.abs(qt.dequantize(jnp.float32)[0] - w[0]).mean())
+    rel0 = err0 / float(jnp.abs(w[0]).mean())
+    assert rel0 < 0.01, rel0
+
+
 def test_quantized_tensor_is_pytree_node():
     qt = quantize_array(jnp.ones((4, 4)))
     leaves = jax.tree.leaves(qt)
