@@ -39,7 +39,12 @@ def main():
         remat="full",
         max_seq_len=2048,
         use_flash_attention=True,
-        loss_chunk_size=512,
+        # tuned on v5e: large flash tiles amortize Mosaic per-program
+        # overhead (sweep: 256x512 -> 41.7%, 1024x1024 -> 46.0% MFU);
+        # chunk 256 beats 512 by ~1 point on the fused CE
+        flash_block_q=1024,
+        flash_block_kv=1024,
+        loss_chunk_size=256,
     )
     batch, seq = 12, 2048
 
@@ -69,7 +74,9 @@ def main():
     # warmup / compile (block via host transfer: on the axon tunnel backend
     # block_until_ready returns before execution completes)
     state, metrics = step(state, data)
-    float(metrics["loss"])
+    loss0 = float(metrics["loss"])
+    if not np.isfinite(loss0):
+        raise RuntimeError(f"non-finite loss {loss0} on the bench step")
 
     iters = 10
     t0 = time.perf_counter()

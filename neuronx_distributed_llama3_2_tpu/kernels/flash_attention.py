@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_KV = 512
 
 
@@ -35,6 +36,7 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
     block_kv: int = DEFAULT_BLOCK_KV,
 ) -> jax.Array:
     """Causal (or full) attention over (B, S, N, D) q and (B, S, Nkv, D) k/v
@@ -51,7 +53,8 @@ def flash_attention(
         )
 
         return pallas_flash_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, block_kv=block_kv
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            block_q=block_q, block_kv=block_kv,
         )
     return flash_attention_reference(
         q, k, v, causal=causal, segment_ids=segment_ids, block_kv=block_kv

@@ -1,0 +1,156 @@
+"""Ring attention: context parallelism for long sequences.
+
+The reference has NO context-parallel strategy — its long-context story is
+Megatron-SP + selective checkpointing + the NKI flash kernel, tested to 32K
+(SURVEY §2.10 long-context row; test_long_seqlen.py:13). On TPU we make
+sequence/context parallelism first-class: the sequence dim is sharded over a
+``cp`` mesh axis and attention runs as a **ring** — each device holds one
+q/k/v sequence chunk, k/v chunks rotate around the ring via
+``lax.ppermute`` (one ICI hop per step), and each device folds every
+visiting k/v chunk into its local queries' online-softmax state. Peak memory
+is O(S/cp) per device; comm is the k/v chunk per step, overlappable with
+the chunk's attention math.
+
+Causality over chunks: with contiguous partitioning, ring step r on device i
+sees the k/v chunk of device ``(i - r) mod cp``; chunks entirely in the
+future are masked (their compute is wasted — the classic contiguous-ring
+imbalance; zigzag balancing is a planned refinement), the diagonal chunk is
+causal-masked, past chunks attend fully.
+
+Autodiff: the ring is a ``lax.scan`` whose carry is the (acc, m, l) softmax
+state plus the rotating k/v; each step is ``jax.checkpoint``-ed, so the
+backward replays single steps (XLA differentiates the ppermute into the
+reverse rotation) — activation memory stays O(S/cp), matching the forward.
+
+Usage: inside a shard_map manual over the cp axis (the model wraps this;
+:func:`ring_attention` is also usable standalone), with q/k/v already
+RoPE'd — rope is elementwise in sequence so it stays outside, auto-sharded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _chunk_attn_stats(q, k, v, q_off, kv_off, causal, kv_len):
+    """Blockwise attention of local q against one k/v chunk, returning the
+    combinable online-softmax triple (acc, m, l).
+
+    q (B, Sq, N, D); k/v (B, Skv, Nkv, D); positions are global:
+    ``q_off + i`` for query i, ``kv_off + j`` for key j.
+    """
+    b, sq, n, d = q.shape
+    nkv = k.shape[2]
+    group = n // nkv
+    scale = d ** -0.5
+    NEG = jnp.float32(-1e30)
+
+    qg = q.reshape(b, sq, nkv, group, d).astype(jnp.float32) * scale
+    s = jnp.einsum("bsngd,btnd->bsngt", qg, k.astype(jnp.float32))
+
+    mask = jnp.ones((sq, k.shape[1]), bool)
+    if causal:
+        q_pos = q_off + lax.iota(jnp.int32, sq)
+        kv_pos = kv_off + lax.iota(jnp.int32, k.shape[1])
+        mask = kv_pos[None, :] <= q_pos[:, None]
+    if kv_len is not None:
+        kv_pos = kv_off + lax.iota(jnp.int32, k.shape[1])
+        mask = mask & (kv_pos < kv_len)[None, :]
+    mask = mask[None, :, None, None, :]
+    s = jnp.where(mask, s, NEG)
+
+    m = jnp.max(s, axis=-1)  # (B, Sq, Nkv, G)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bsngt,btnd->bsngd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    kv_len: Optional[int] = None,
+) -> jax.Array:
+    """Exact attention over the cp-sharded sequence (call under shard_map
+    manual over ``axis_name``). q/k/v are the local chunks (B, S/cp, N, D) /
+    (B, S/cp, Nkv, D) of a contiguous sequence split; returns the local
+    output chunk (B, S/cp, N, D)."""
+    cp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_loc, n, d = q.shape
+    nkv = k.shape[2]
+    group = n // nkv
+    NEG = jnp.float32(-1e30)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, r):
+        acc, m, l, kc, vc = carry
+        src = (idx - r) % cp  # which device's chunk is visiting
+        a2, m2, l2 = _chunk_attn_stats(
+            q, kc, vc,
+            q_off=idx * s_loc,
+            kv_off=src * s_loc,
+            causal=causal,
+            kv_len=kv_len,
+        )
+        m_new = jnp.maximum(m, m2)
+        # fully-masked chunks keep m2 == -1e30: their alpha2 underflows to 0
+        alpha = jnp.exp(m - m_new)
+        alpha2 = jnp.exp(m2 - m_new)
+        acc = acc * alpha[..., None] + a2 * alpha2[..., None]
+        l = l * alpha + l2 * alpha2
+        # rotate k/v one hop around the ring (ICI neighbor exchange)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (acc, m_new, l, kc, vc), None
+
+    init = (
+        jnp.zeros((b, s_loc, nkv, group, d), jnp.float32),
+        jnp.full((b, s_loc, nkv, group), NEG),
+        jnp.zeros((b, s_loc, nkv, group), jnp.float32),
+        k,
+        v,
+    )
+    (acc, m, l, _, _), _ = lax.scan(
+        jax.checkpoint(step), init, jnp.arange(cp)
+    )
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.reshape(b, s_loc, n, d).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Global-view entry point: q/k/v (B, S, N, D) with S sharded over
+    ``axis_name``; wraps :func:`ring_attention` in a partial-manual
+    shard_map. Only the cp axis goes manual — specs may not mention other
+    axes, so batch (dp/ep) and head (tp) shardings stay GSPMD-auto."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, kv_len=q.shape[1]
+    )
+    return jax.shard_map(
+        lambda q, k, v: fn(q, k, v),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis_name},
+        check_vma=False,
+    )(q, k, v)

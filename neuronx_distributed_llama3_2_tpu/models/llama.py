@@ -82,6 +82,9 @@ class LlamaConfig:
     # use the Pallas flash-attention kernel for core attention (reference
     # nki_flash_attn_func opt-in, modeling_llama_nxd.py:410-417)
     use_flash_attention: bool = False
+    # flash kernel tile sizes (perf knobs; defaults in kernels/)
+    flash_block_q: Optional[int] = None
+    flash_block_kv: Optional[int] = None
     # chunk the LM head + CE over the sequence so full (B,S,V) logits never
     # materialize; None disables (loss-memory redesign, no reference analogue)
     loss_chunk_size: Optional[int] = None
@@ -325,11 +328,36 @@ class LlamaAttention:
         q = save_flat(q, "q_rope")
         k = save_flat(k, "kv_rope")
         v = save_flat(v, "kv_rope")
-        if c.use_flash_attention:
+        cp = (
+            parallel_state.get_context_parallel_size()
+            if parallel_state.model_parallel_is_initialized()
+            else 1
+        )
+        if cp > 1:
+            # context parallelism: sequence stays cp-sharded; attention runs
+            # as a k/v ring over the cp axis (kernels/ring_attention.py) —
+            # the only op in the block that mixes sequence positions
+            from neuronx_distributed_llama3_2_tpu.kernels.ring_attention import (
+                ring_attention_sharded,
+            )
+
+            attn = ring_attention_sharded(
+                q, k, v,
+                parallel_state.get_parallel_state().mesh,
+                parallel_state.CP_AXIS,
+                causal=True,
+            )
+        elif c.use_flash_attention:
             from neuronx_distributed_llama3_2_tpu.kernels.flash_attention import (
+                DEFAULT_BLOCK_KV,
+                DEFAULT_BLOCK_Q,
                 flash_attention,
             )
-            attn = flash_attention(q, k, v, causal=True)
+            attn = flash_attention(
+                q, k, v, causal=True,
+                block_q=c.flash_block_q or DEFAULT_BLOCK_Q,
+                block_kv=c.flash_block_kv or DEFAULT_BLOCK_KV,
+            )
         else:
             attn = core_attention(q, k, v, causal=True)
         attn = attn.reshape(b, s, c.num_heads * c.head_dim)
@@ -696,18 +724,27 @@ def params_to_hf(params: Params, config: LlamaConfig) -> Dict[str, Any]:
         "model.embed_tokens.weight": np32(params["embed"]["embedding"]),
         "model.norm.weight": np32(params["final_norm"]["scale"]),
     }
+    # one device->host transfer per stacked tensor, then index host-side
+    # (per-layer slicing of device arrays would issue L x 7 blocking syncs)
     gate_up = np32(lyr["mlp"]["gate_up"])  # (L, H, 2, I)
+    attn_norm = np32(lyr["attn_norm"]["scale"])
+    mlp_norm = np32(lyr["mlp_norm"]["scale"])
+    q_k = np32(lyr["attn"]["qkv"]["q_kernel"])
+    k_k = np32(lyr["attn"]["qkv"]["k_kernel"])
+    v_k = np32(lyr["attn"]["qkv"]["v_kernel"])
+    o_k = np32(lyr["attn"]["o"]["kernel"])
+    down = np32(lyr["mlp"]["down"]["kernel"])
     for i in range(L):
         p = f"model.layers.{i}."
-        sd[p + "input_layernorm.weight"] = np32(lyr["attn_norm"]["scale"][i])
-        sd[p + "post_attention_layernorm.weight"] = np32(lyr["mlp_norm"]["scale"][i])
-        sd[p + "self_attn.q_proj.weight"] = np32(lyr["attn"]["qkv"]["q_kernel"][i]).T
-        sd[p + "self_attn.k_proj.weight"] = np32(lyr["attn"]["qkv"]["k_kernel"][i]).T
-        sd[p + "self_attn.v_proj.weight"] = np32(lyr["attn"]["qkv"]["v_kernel"][i]).T
-        sd[p + "self_attn.o_proj.weight"] = np32(lyr["attn"]["o"]["kernel"][i]).T
+        sd[p + "input_layernorm.weight"] = attn_norm[i]
+        sd[p + "post_attention_layernorm.weight"] = mlp_norm[i]
+        sd[p + "self_attn.q_proj.weight"] = q_k[i].T
+        sd[p + "self_attn.k_proj.weight"] = k_k[i].T
+        sd[p + "self_attn.v_proj.weight"] = v_k[i].T
+        sd[p + "self_attn.o_proj.weight"] = o_k[i].T
         sd[p + "mlp.gate_proj.weight"] = gate_up[i, :, 0, :].T
         sd[p + "mlp.up_proj.weight"] = gate_up[i, :, 1, :].T
-        sd[p + "mlp.down_proj.weight"] = np32(lyr["mlp"]["down"]["kernel"][i]).T
+        sd[p + "mlp.down_proj.weight"] = down[i].T
     if not c.tie_word_embeddings:
         sd["lm_head.weight"] = np32(params["lm_head"]["kernel"]).T
     return sd

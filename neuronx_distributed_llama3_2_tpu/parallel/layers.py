@@ -47,10 +47,21 @@ BATCH_AXES = (DP_AXIS, EP_AXIS)
 
 def _activation_spec(y: jax.Array, last_axis) -> P:
     """Spec for an activation (batch..., feature): batch dims over the DP
-    axes (first dim only), middle dims unsharded, last dim ``last_axis``."""
+    axes (first dim only), middle dims unsharded — except the sequence dim
+    of (B, S, F) activations, which rides the cp axis under context
+    parallelism (ring attention, kernels/ring_attention.py) — and last dim
+    ``last_axis``."""
     if y.ndim < 2:
         return P(last_axis)
-    return P(BATCH_AXES, *((None,) * (y.ndim - 2)), last_axis)
+    middle = [None] * (y.ndim - 2)
+    if (
+        y.ndim == 3
+        and middle
+        and parallel_state.model_parallel_is_initialized()
+        and parallel_state.get_parallel_state().context_parallel_size > 1
+    ):
+        middle[0] = parallel_state.CP_AXIS
+    return P(BATCH_AXES, *middle, last_axis)
 
 
 def constrain(x: jax.Array, spec: P) -> jax.Array:

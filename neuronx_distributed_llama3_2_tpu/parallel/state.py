@@ -8,7 +8,7 @@ parallel_state.py:60 and the rank-tensor reshape ``[PP, DP, TP]`` /
 Instead of per-rank ``torch.distributed`` process groups, we build a single
 ``jax.sharding.Mesh`` whose axis order mirrors the reference's rank layout:
 
-    (pp, dp, ep, tp)   with tp innermost (stride 1)
+    (pp, dp, cp, ep, tp)   with tp innermost (stride 1)
 
 so that the tensor-parallel axis maps onto physically adjacent devices
 (ICI-adjacent on TPU, the analogue of the reference's "TP contiguous for
@@ -40,9 +40,13 @@ logger = get_logger()
 # Canonical mesh axis names, outermost to innermost.
 PP_AXIS = "pp"
 DP_AXIS = "dp"
+# context parallelism: sequence dim sharded, attention runs as a ring
+# (kernels/ring_attention.py). No reference analogue — the reference's
+# long-context story stops at Megatron-SP (SURVEY §2.10); cp extends it.
+CP_AXIS = "cp"
 EP_AXIS = "ep"
 TP_AXIS = "tp"
-MESH_AXES = (PP_AXIS, DP_AXIS, EP_AXIS, TP_AXIS)
+MESH_AXES = (PP_AXIS, DP_AXIS, CP_AXIS, EP_AXIS, TP_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +59,7 @@ class ParallelConfig:
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
     expert_parallel_size: int = 1
+    context_parallel_size: int = 1
     # Megatron-style sequence parallelism: activations sharded along the
     # sequence dim over the *tp* axis between TP blocks (reference §2.10 SP).
     sequence_parallel: bool = False
@@ -65,6 +70,12 @@ class ParallelConfig:
                 v = getattr(self, f.name)
                 if not isinstance(v, int) or v < 1:
                     raise ValueError(f"{f.name} must be a positive int, got {v!r}")
+        if self.sequence_parallel and self.context_parallel_size > 1:
+            raise ValueError(
+                "sequence_parallel (Megatron SP over tp) and "
+                "context_parallel_size > 1 both shard the sequence dim; "
+                "enable one of them"
+            )
 
     @property
     def model_parallel_size(self) -> int:
@@ -89,6 +100,10 @@ class ParallelState:
     @property
     def expert_parallel_size(self) -> int:
         return self.mesh.shape[EP_AXIS]
+
+    @property
+    def context_parallel_size(self) -> int:
+        return self.mesh.shape[CP_AXIS]
 
     @property
     def data_parallel_size(self) -> int:
@@ -121,22 +136,23 @@ def build_mesh(
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    tp, pp, ep = (
+    tp, pp, ep, cp = (
         config.tensor_parallel_size,
         config.pipeline_parallel_size,
         config.expert_parallel_size,
+        config.context_parallel_size,
     )
-    if n % (tp * pp) != 0:
+    if n % (tp * pp * cp) != 0:
         raise ValueError(
-            f"world size {n} not divisible by tp*pp = {tp}*{pp}"
+            f"world size {n} not divisible by tp*pp*cp = {tp}*{pp}*{cp}"
         )
-    dp_total = n // (tp * pp)
+    dp_total = n // (tp * pp * cp)
     if dp_total % ep != 0:
         raise ValueError(
             f"data parallel size {dp_total} not divisible by expert_parallel_size {ep}"
         )
     dp = dp_total // ep
-    dev_array = np.asarray(devices).reshape(pp, dp, ep, tp)
+    dev_array = np.asarray(devices).reshape(pp, dp, cp, ep, tp)
     return Mesh(dev_array, MESH_AXES)
 
 
@@ -144,6 +160,7 @@ def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
     expert_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
     sequence_parallel: bool = False,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> ParallelState:
@@ -164,6 +181,7 @@ def initialize_model_parallel(
         tensor_parallel_size=tensor_model_parallel_size,
         pipeline_parallel_size=pipeline_model_parallel_size,
         expert_parallel_size=expert_model_parallel_size,
+        context_parallel_size=context_parallel_size,
         sequence_parallel=sequence_parallel,
     )
     mesh = build_mesh(config, devices)
@@ -210,6 +228,10 @@ def get_pipeline_model_parallel_size() -> int:
 
 def get_expert_model_parallel_size() -> int:
     return get_parallel_state().expert_parallel_size
+
+
+def get_context_parallel_size() -> int:
+    return get_parallel_state().context_parallel_size
 
 
 def get_data_parallel_size() -> int:

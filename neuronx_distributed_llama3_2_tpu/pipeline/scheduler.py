@@ -28,6 +28,9 @@ class PipelineTask:
     """One unit of per-rank work (reference task classes scheduler.py:4-70)."""
 
     mb: int  # microbatch index
+    # virtual-pipeline model chunk (interleaved schedule only; reference
+    # scheduler.py:319-353 model-chunk math). 0 for non-interleaved.
+    chunk: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -169,3 +172,99 @@ class Train1F1BSchedule(PipeSchedule):
         for mb in range(steady, n):
             yield self._bwd_tasks(mb)
         yield [ReduceGradsTask(-1)]
+
+
+class TrainInterleavedSchedule(PipeSchedule):
+    """Interleaved virtual-pipeline (VPP) schedule (reference
+    ``TrainInterleavedSchedule`` scheduler.py:256, itself the Megatron/Apex
+    interleaving): each pp rank owns ``num_model_chunks`` non-contiguous
+    layer chunks, shrinking the bubble from (pp-1)/M to (pp-1)/(M·chunks).
+
+    Pure-logic specification (hardware-free, like the reference's): the
+    chunk/microbatch assignment math mirrors scheduler.py:319-353 —
+    warmup = 2·(pp - rank - 1) + (chunks - 1)·pp steps (:303-309, capped at
+    total), steady-state 1F1B over (step → chunk, microbatch) with backward
+    running ``warmup`` steps late. The SPMD executors realize the gpipe and
+    1f1b schedules today; the VPP timing is specified and oracle-tested here
+    for the (pp·chunks)-stage executor extension.
+    """
+
+    def __init__(
+        self,
+        num_microbatches: int,
+        num_model_chunks: int,
+        pp_size: int,
+        pp_rank: int,
+    ):
+        super().__init__(num_microbatches, pp_size, pp_rank)
+        if num_model_chunks < 1:
+            raise ValueError(f"num_model_chunks must be >= 1, got {num_model_chunks}")
+        if num_microbatches % pp_size != 0:
+            # reference scheduler.py:306-309 raises the same constraint
+            raise ValueError(
+                f"interleaved pipeline requires num_microbatches % pp == 0, "
+                f"got {num_microbatches} % {pp_size}"
+            )
+        self.num_model_chunks = num_model_chunks
+        self.total_steps = num_microbatches * num_model_chunks
+        if num_microbatches == pp_size:
+            self.num_warmup = self.total_steps
+        else:
+            warmup = 2 * (pp_size - pp_rank - 1) + (num_model_chunks - 1) * pp_size
+            self.num_warmup = min(warmup, self.total_steps)
+
+    # -- chunk/microbatch math (reference scheduler.py:319-353) -----------
+
+    def model_chunk_id(self, step_id: int, is_forward: bool = True) -> int:
+        if not is_forward:
+            step_id -= self.num_warmup
+        group = self.pp_size * self.num_model_chunks
+        cid = (step_id % group) // self.pp_size
+        if not is_forward:
+            cid = self.num_model_chunks - cid - 1
+        return cid
+
+    def microbatch_id(self, step_id: int, is_forward: bool = True) -> int:
+        if not is_forward:
+            step_id -= self.num_warmup
+        group = self.pp_size * self.num_model_chunks
+        return (step_id // group) * self.pp_size + (step_id % group) % self.pp_size
+
+    # -- task emission ----------------------------------------------------
+
+    def steps(self):
+        total, warmup = self.total_steps, self.num_warmup
+        # warmup: forwards only
+        for t in range(warmup):
+            yield self._chunk_fwd(t)
+        # steady state: one fwd + one bwd per step
+        for t in range(warmup, total):
+            yield self._chunk_fwd(t) + self._chunk_bwd(t)
+        # cooldown: backwards only
+        for t in range(total, total + warmup):
+            yield self._chunk_bwd(t)
+        yield [ReduceGradsTask(-1)]
+
+    def _chunk_fwd(self, t):
+        mb = self.microbatch_id(t, True)
+        ck = self.model_chunk_id(t, True)
+        tasks: List[PipelineTask] = []
+        # stage 0 of chunk 0 is the true pipeline entry; every other
+        # (rank, chunk) receives from its predecessor
+        if not (self.is_first and ck == 0):
+            tasks.append(RecvForwardTask(mb, ck))
+        tasks.append(ForwardStepTask(mb, ck))
+        if not (self.is_last and ck == self.num_model_chunks - 1):
+            tasks.append(SendForwardTask(mb, ck))
+        return tasks
+
+    def _chunk_bwd(self, t):
+        mb = self.microbatch_id(t, False)
+        ck = self.model_chunk_id(t, False)
+        tasks: List[PipelineTask] = []
+        if not (self.is_last and ck == self.num_model_chunks - 1):
+            tasks.append(RecvBackwardTask(mb, ck))
+        tasks.append(BackwardStepTask(mb, ck))
+        if not (self.is_first and ck == 0):
+            tasks.append(SendBackwardTask(mb, ck))
+        return tasks

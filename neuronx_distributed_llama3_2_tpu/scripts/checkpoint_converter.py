@@ -72,16 +72,50 @@ def load_hf_state_dict(path: str) -> Dict[str, Any]:
     return sd
 
 
+#: per-file shard budget for exported safetensors (HF convention)
+_SHARD_BYTES = 5 * 2 ** 30
+
+
 def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
-    """Write a safetensors HF checkpoint + minimal config.json."""
+    """Write a safetensors HF checkpoint + minimal config.json.
+
+    Tensors are cast to the model's compute dtype (bf16, matching published
+    Llama-3 checkpoints — fp32 would double size and host memory) and split
+    into ~5GB shards with a ``model.safetensors.index.json`` per the HF
+    convention, so a 70B export neither OOMs the host in one buffer nor
+    produces a single 140GB file."""
+    import jax.numpy as jnp
     import numpy as np
     from safetensors.numpy import save_file
 
     os.makedirs(path, exist_ok=True)
-    save_file(
-        {k: np.ascontiguousarray(v) for k, v in sd.items()},
-        os.path.join(path, "model.safetensors"),
-    )
+    dtype = np.dtype(config.dtype) if config.dtype != jnp.bfloat16 else jnp.bfloat16
+    sd = {
+        k: np.ascontiguousarray(np.asarray(v).astype(dtype)) for k, v in sd.items()
+    }
+
+    # greedy shard split (HF convention: index.json maps tensor -> file)
+    shards, cur, cur_bytes = [], {}, 0
+    for k, v in sd.items():
+        if cur and cur_bytes + v.nbytes > _SHARD_BYTES:
+            shards.append(cur)
+            cur, cur_bytes = {}, 0
+        cur[k] = v
+        cur_bytes += v.nbytes
+    shards.append(cur)
+
+    if len(shards) == 1:
+        save_file(shards[0], os.path.join(path, "model.safetensors"))
+    else:
+        index = {"metadata": {"total_size": sum(v.nbytes for v in sd.values())},
+                 "weight_map": {}}
+        for i, shard in enumerate(shards):
+            name = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+            save_file(shard, os.path.join(path, name))
+            for k in shard:
+                index["weight_map"][k] = name
+        with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+            json.dump(index, f, indent=2)
     cfg = {
         "architectures": ["LlamaForCausalLM"],
         "model_type": "llama",
@@ -95,8 +129,7 @@ def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
         "rope_theta": config.rope_theta,
         "tie_word_embeddings": config.tie_word_embeddings,
         "max_position_embeddings": config.max_seq_len,
-        # tensors are exported fp32 (params_to_hf)
-        "torch_dtype": "float32",
+        "torch_dtype": str(jnp.dtype(config.dtype)),
     }
     if config.rope_scaling is not None:
         # HF "llama3" rope scaling dict — omitting it would silently load

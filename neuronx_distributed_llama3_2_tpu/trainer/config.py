@@ -76,6 +76,7 @@ class TrainingConfig:
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
     expert_parallel_size: int = 1
+    context_parallel_size: int = 1
     sequence_parallel: bool = False
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     # per-step global batch is split into this many sequential microbatches
@@ -90,6 +91,7 @@ class TrainingConfig:
             tensor_model_parallel_size=self.tensor_parallel_size,
             pipeline_model_parallel_size=self.pipeline_parallel_size,
             expert_model_parallel_size=self.expert_parallel_size,
+            context_parallel_size=self.context_parallel_size,
             sequence_parallel=self.sequence_parallel,
             devices=devices,
         )

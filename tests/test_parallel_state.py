@@ -10,7 +10,7 @@ def test_mesh_shape_tp_pp():
     assert st.tensor_parallel_size == 4
     assert st.pipeline_parallel_size == 2
     assert st.data_parallel_size == 1
-    assert dict(st.mesh.shape) == {"pp": 2, "dp": 1, "ep": 1, "tp": 4}
+    assert dict(st.mesh.shape) == {"pp": 2, "dp": 1, "cp": 1, "ep": 1, "tp": 4}
 
 
 def test_mesh_tp_innermost_contiguous():
@@ -21,7 +21,7 @@ def test_mesh_tp_innermost_contiguous():
     ids = [d.id for d in devs]
     assert ids == sorted(ids)
     # first tp group = devices 0,1
-    tp_row = st.mesh.devices[0, 0, 0, :]
+    tp_row = st.mesh.devices[0, 0, 0, 0, :]
     assert [d.id for d in tp_row] == [0, 1]
 
 

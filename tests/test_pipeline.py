@@ -270,3 +270,70 @@ def test_1f1b_activation_memory_below_gpipe():
         ma = fn.lower(params, ids, ids).compile().memory_analysis()
         temps[sched] = ma.temp_size_in_bytes
     assert temps["1f1b"] < 0.8 * temps["gpipe"], temps
+
+
+# ---------------------------------------------------------------------------
+# interleaved VPP schedule (reference scheduler.py:256)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pp,chunks,mb", [(2, 2, 4), (4, 2, 8), (4, 3, 8)])
+def test_interleaved_covers_all_work_once(pp, chunks, mb):
+    """Every (microbatch, chunk) pair gets exactly one fwd and one bwd on
+    every rank, and each bwd follows its fwd (reference equivalence tier)."""
+    from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (
+        TrainInterleavedSchedule,
+    )
+
+    for rank in range(pp):
+        sched = TrainInterleavedSchedule(mb, chunks, pp, rank)
+        tasks = sched.flat_tasks()
+        fwd = [(t.mb, t.chunk) for t in tasks if isinstance(t, ForwardStepTask)]
+        bwd = [(t.mb, t.chunk) for t in tasks if isinstance(t, BackwardStepTask)]
+        want = {(m, c) for m in range(mb) for c in range(chunks)}
+        assert set(fwd) == want and len(fwd) == len(want)
+        assert set(bwd) == want and len(bwd) == len(want)
+        pos = {}
+        for i, t in enumerate(tasks):
+            pos[(type(t), t.mb, t.chunk)] = i
+        for m, c in want:
+            assert pos[(BackwardStepTask, m, c)] > pos[(ForwardStepTask, m, c)]
+        assert isinstance(tasks[-1], ReduceGradsTask)
+
+
+def test_interleaved_warmup_matches_reference_formula():
+    from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (
+        TrainInterleavedSchedule,
+    )
+
+    # reference scheduler.py:303-309: warmup = 2*(pp-rank-1) + (chunks-1)*pp
+    assert TrainInterleavedSchedule(8, 2, 4, 0).num_warmup == 2 * 3 + 4
+    assert TrainInterleavedSchedule(8, 2, 4, 3).num_warmup == 0 + 4
+    # num_microbatches == pp: all-warmup (reference :311-312)
+    assert TrainInterleavedSchedule(4, 2, 4, 1).num_warmup == 8
+
+
+def test_interleaved_rejects_indivisible_microbatches():
+    from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (
+        TrainInterleavedSchedule,
+    )
+
+    with pytest.raises(ValueError):
+        TrainInterleavedSchedule(6, 2, 4, 0)
+
+
+def test_interleaved_chunk_order_first_rank():
+    """First rank's warmup walks chunk 0 for pp microbatches, then chunk 1
+    (the Megatron group-of-pp pattern, reference get_model_chunk_id)."""
+    from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (
+        TrainInterleavedSchedule,
+    )
+
+    sched = TrainInterleavedSchedule(8, 2, 4, 0)
+    fwd_order = [
+        (t.mb, t.chunk)
+        for t in sched.flat_tasks()
+        if isinstance(t, ForwardStepTask)
+    ][:8]
+    assert fwd_order == [
+        (0, 0), (1, 0), (2, 0), (3, 0), (0, 1), (1, 1), (2, 1), (3, 1)
+    ]
