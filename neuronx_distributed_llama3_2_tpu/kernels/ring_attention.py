@@ -37,38 +37,68 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _chunk_attn_stats(q, k, v, q_off, kv_off, causal, kv_len):
-    """Blockwise attention of local q against one k/v chunk, returning the
-    combinable online-softmax triple (acc, m, l).
+DEFAULT_RING_BLOCK_KV = 512
+
+
+def _chunk_attn_stats(
+    q, k, v, q_off, kv_off, causal, kv_len, block_kv=DEFAULT_RING_BLOCK_KV
+):
+    """Blockwise (flash-style) attention of local q against one k/v chunk,
+    returning the combinable online-softmax triple (acc, m, l).
 
     q (B, Sq, N, D); k/v (B, Skv, Nkv, D); positions are global:
-    ``q_off + i`` for query i, ``kv_off + j`` for key j.
+    ``q_off + i`` for query i, ``kv_off + j`` for key j. The inner loop
+    scans kv in ``block_kv`` tiles so peak memory per ring step is
+    O(Sq · block_kv), not O(Sq · Skv) — without this the ring would undo
+    the long-context memory win it exists for.
     """
     b, sq, n, d = q.shape
-    nkv = k.shape[2]
+    skv, nkv = k.shape[1], k.shape[2]
     group = n // nkv
     scale = d ** -0.5
     NEG = jnp.float32(-1e30)
 
     qg = q.reshape(b, sq, nkv, group, d).astype(jnp.float32) * scale
-    s = jnp.einsum("bsngd,btnd->bsngt", qg, k.astype(jnp.float32))
+    q_pos = q_off + lax.iota(jnp.int32, sq)
 
-    mask = jnp.ones((sq, k.shape[1]), bool)
-    if causal:
-        q_pos = q_off + lax.iota(jnp.int32, sq)
-        kv_pos = kv_off + lax.iota(jnp.int32, k.shape[1])
-        mask = kv_pos[None, :] <= q_pos[:, None]
-    if kv_len is not None:
-        kv_pos = kv_off + lax.iota(jnp.int32, k.shape[1])
-        mask = mask & (kv_pos < kv_len)[None, :]
-    mask = mask[None, :, None, None, :]
-    s = jnp.where(mask, s, NEG)
+    block_kv = min(block_kv, skv)
+    nblk = -(-skv // block_kv)
+    pad = nblk * block_kv - skv
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = jnp.moveaxis(kf.reshape(b, nblk, block_kv, nkv, d), 1, 0)
+    vb = jnp.moveaxis(vf.reshape(b, nblk, block_kv, nkv, d), 1, 0)
+    pos_b = (kv_off + lax.iota(jnp.int32, nblk * block_kv)).reshape(nblk, block_kv)
+    valid_b = (lax.iota(jnp.int32, nblk * block_kv) < skv).reshape(nblk, block_kv)
 
-    m = jnp.max(s, axis=-1)  # (B, Sq, Nkv, G)
-    p = jnp.exp(s - m[..., None])
-    p = jnp.where(mask, p, 0.0)
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bsngt,btnd->bsngd", p, v.astype(jnp.float32))
+    def body(carry, blk):
+        acc, m, l = carry
+        kblk, vblk, kv_pos, valid = blk
+        s = jnp.einsum("bsngd,btnd->bsngt", qg, kblk)
+        mask = valid[None, :]
+        if causal:
+            mask = mask & (kv_pos[None, :] <= q_pos[:, None])
+        if kv_len is not None:
+            mask = mask & (kv_pos < kv_len)[None, :]
+        mask = mask[None, :, None, None, :]
+        s = jnp.where(mask, s, NEG)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bsngt,btnd->bsngd", p, vblk)
+        return (acc, m_new, l), None
+
+    init = (
+        jnp.zeros((b, sq, nkv, group, d), jnp.float32),
+        jnp.full((b, sq, nkv, group), NEG),
+        jnp.zeros((b, sq, nkv, group), jnp.float32),
+    )
+    (acc, m, l), _ = lax.scan(body, init, (kb, vb, pos_b, valid_b))
     return acc, m, l
 
 
@@ -87,43 +117,48 @@ def ring_attention(
     cp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, s_loc, n, d = q.shape
-    nkv = k.shape[2]
-    group = n // nkv
-    NEG = jnp.float32(-1e30)
 
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
-    def step(carry, r):
-        acc, m, l, kc, vc = carry
+    def merge(carry, stats):
+        acc, m, l = carry
+        a2, m2, l2 = stats
+        m_new = jnp.maximum(m, m2)
+        # fully-masked chunks keep m2 == -1e30: their alpha2 underflows to 0
+        alpha = jnp.exp(m - m_new)
+        alpha2 = jnp.exp(m2 - m_new)
+        return (
+            acc * alpha[..., None] + a2 * alpha2[..., None],
+            m_new,
+            l * alpha + l2 * alpha2,
+        )
+
+    def stats_for(kc, vc, r):
         src = (idx - r) % cp  # which device's chunk is visiting
-        a2, m2, l2 = _chunk_attn_stats(
+        return _chunk_attn_stats(
             q, kc, vc,
             q_off=idx * s_loc,
             kv_off=src * s_loc,
             causal=causal,
             kv_len=kv_len,
         )
-        m_new = jnp.maximum(m, m2)
-        # fully-masked chunks keep m2 == -1e30: their alpha2 underflows to 0
-        alpha = jnp.exp(m - m_new)
-        alpha2 = jnp.exp(m2 - m_new)
-        acc = acc * alpha[..., None] + a2 * alpha2[..., None]
-        l = l * alpha + l2 * alpha2
-        # rotate k/v one hop around the ring (ICI neighbor exchange)
+
+    def step(carry, r):
+        acc, m, l, kc, vc = carry
+        # rotate first (r starts at 1): the local chunk was consumed before
+        # the scan, and no dead hop is paid after the last visiting chunk
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (acc, m_new, l, kc, vc), None
+        acc, m, l = merge((acc, m, l), stats_for(kc, vc, r))
+        return (acc, m, l, kc, vc), None
 
-    init = (
-        jnp.zeros((b, s_loc, nkv, group, d), jnp.float32),
-        jnp.full((b, s_loc, nkv, group), NEG),
-        jnp.zeros((b, s_loc, nkv, group), jnp.float32),
-        k,
-        v,
-    )
-    (acc, m, l, _, _), _ = lax.scan(
-        jax.checkpoint(step), init, jnp.arange(cp)
-    )
+    local = jax.checkpoint(stats_for)(k, v, 0)
+    if cp > 1:
+        (acc, m, l, _, _), _ = lax.scan(
+            jax.checkpoint(step), (*local, k, v), jnp.arange(1, cp)
+        )
+    else:
+        acc, m, l = local
     out = acc / jnp.maximum(l[..., None], 1e-30)
     return out.reshape(b, s_loc, n, d).astype(q.dtype)
 
@@ -143,8 +178,10 @@ def ring_attention_sharded(
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)
+    # kv_len=None: the sequence is exactly S with no padding; pass a real
+    # length here only when wiring padded-batch support
     fn = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal, kv_len=q.shape[1]
+        ring_attention, axis_name=axis_name, causal=causal, kv_len=None
     )
     return jax.shard_map(
         lambda q, k, v: fn(q, k, v),

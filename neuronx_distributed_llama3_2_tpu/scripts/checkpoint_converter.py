@@ -90,29 +90,35 @@ def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
 
     os.makedirs(path, exist_ok=True)
     dtype = np.dtype(config.dtype) if config.dtype != jnp.bfloat16 else jnp.bfloat16
-    sd = {
-        k: np.ascontiguousarray(np.asarray(v).astype(dtype)) for k, v in sd.items()
-    }
+    itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
 
-    # greedy shard split (HF convention: index.json maps tensor -> file)
-    shards, cur, cur_bytes = [], {}, 0
+    # greedy shard split by post-cast size (HF convention: index.json maps
+    # tensor -> file); tensors are cast per shard at write time so the extra
+    # host footprint is one shard, not a full second copy of the model
+    shards, cur, cur_bytes = [], [], 0
     for k, v in sd.items():
-        if cur and cur_bytes + v.nbytes > _SHARD_BYTES:
+        nbytes = v.size * itemsize
+        if cur and cur_bytes + nbytes > _SHARD_BYTES:
             shards.append(cur)
-            cur, cur_bytes = {}, 0
-        cur[k] = v
-        cur_bytes += v.nbytes
+            cur, cur_bytes = [], 0
+        cur.append(k)
+        cur_bytes += nbytes
     shards.append(cur)
 
+    def cast_shard(keys):
+        return {
+            k: np.ascontiguousarray(np.asarray(sd[k]).astype(dtype)) for k in keys
+        }
+
     if len(shards) == 1:
-        save_file(shards[0], os.path.join(path, "model.safetensors"))
+        save_file(cast_shard(shards[0]), os.path.join(path, "model.safetensors"))
     else:
-        index = {"metadata": {"total_size": sum(v.nbytes for v in sd.values())},
-                 "weight_map": {}}
-        for i, shard in enumerate(shards):
+        total = sum(v.size * itemsize for v in sd.values())
+        index = {"metadata": {"total_size": total}, "weight_map": {}}
+        for i, keys in enumerate(shards):
             name = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
-            save_file(shard, os.path.join(path, name))
-            for k in shard:
+            save_file(cast_shard(keys), os.path.join(path, name))
+            for k in keys:
                 index["weight_map"][k] = name
         with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
             json.dump(index, f, indent=2)
