@@ -61,14 +61,34 @@ def flash_attention(
     )
 
 
-def flash_attention_reference(
+NEG = jnp.float32(-1e30)
+
+
+def blockwise_attention_stats(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    q_off=0,
+    kv_off=0,
+    kv_len: Optional[jax.Array] = None,
     block_kv: int = DEFAULT_BLOCK_KV,
-) -> jax.Array:
+):
+    """Online-softmax block loop returning the combinable triple
+    ``(acc, m, l)`` with acc (B, Sq, Nkv, G, D), m/l (B, Sq, Nkv, G) fp32.
+
+    The single source of truth for blockwise attention numerics — both
+    :func:`flash_attention_reference` (normalize of these stats) and the
+    ring-attention executor (merging stats across visiting chunks,
+    kernels/ring_attention.py) build on it. ``q_off``/``kv_off`` are the
+    global positions of q[.,0] / k[.,0] (the ring's chunks live at
+    different global offsets); ``kv_len`` optionally masks positions >= it.
+    Each block step is ``jax.checkpoint``-ed so the backward recomputes the
+    (Sq, block) score tile instead of storing every block's softmax —
+    keeping training memory at O(Sq·block_kv), not O(Sq·Skv).
+    """
     b, sq, n, d = q.shape
     skv, nkv = k.shape[1], k.shape[2]
     group = n // nkv
@@ -79,6 +99,7 @@ def flash_attention_reference(
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
 
+    block_kv = min(block_kv, skv)
     nblk = -(-skv // block_kv)  # ceil
     pad = nblk * block_kv - skv
     if pad:
@@ -87,31 +108,31 @@ def flash_attention_reference(
     kb = kf.reshape(b, nblk, block_kv, nkv, d)
     vb = vf.reshape(b, nblk, block_kv, nkv, d)
 
-    q_pos = lax.iota(jnp.int32, sq)  # (Sq,)
-    kv_pos_all = lax.iota(jnp.int32, nblk * block_kv)
+    q_pos = q_off + lax.iota(jnp.int32, sq)  # (Sq,) global
+    kv_pos_all = kv_off + lax.iota(jnp.int32, nblk * block_kv)
+    valid_all = lax.iota(jnp.int32, nblk * block_kv) < skv
     kv_seg_all = None
     if segment_ids is not None:
         kv_seg_all = jnp.pad(
             segment_ids, ((0, 0), (0, pad)), constant_values=-1
         ).reshape(b, nblk, block_kv)
-
-    NEG = jnp.float32(-1e30)
+        if q_segment_ids is None:
+            q_segment_ids = segment_ids
 
     def body(carry, blk):
         acc, m, l = carry  # (B,Sq,Nkv,G,D), (B,Sq,Nkv,G), (B,Sq,Nkv,G)
-        kblk, vblk, kv_pos, kv_seg = blk
+        kblk, vblk, kv_pos, valid, kv_seg = blk
         # scores: (B, Sq, Nkv, G, block)
         s = jnp.einsum("bsngd,btnd->bsngt", qg, kblk)
+        mask = valid[None, :]  # padded tail positions
         if causal:
-            mask = kv_pos[None, :] <= q_pos[:, None]
-        else:
-            mask = jnp.ones((sq, kv_pos.shape[0]), bool)
+            mask = mask & (kv_pos[None, :] <= q_pos[:, None])
+        if kv_len is not None:
+            mask = mask & (kv_pos < kv_len)[None, :]
         mask = mask[None, :, None, None, :]
         if kv_seg is not None:
-            seg_ok = kv_seg[:, None, :] == segment_ids[:, :, None]
+            seg_ok = kv_seg[:, None, :] == q_segment_ids[:, :, None]
             mask = mask & seg_ok[:, :, None, None, :]
-        # padded tail positions are masked through kv_pos >= skv
-        mask = mask & (kv_pos < skv)[None, None, None, None, :]
         s = jnp.where(mask, s, NEG)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
@@ -132,20 +153,34 @@ def flash_attention_reference(
         jnp.moveaxis(kb, 1, 0),
         jnp.moveaxis(vb, 1, 0),
         kv_pos_all.reshape(nblk, block_kv),
+        valid_all.reshape(nblk, block_kv),
         jnp.moveaxis(kv_seg_all, 1, 0)
         if kv_seg_all is not None
         else jnp.zeros((nblk, 1)),
     )
-    if segment_ids is None:
-        def body_noseg(carry, blk):
-            kblk, vblk, kv_pos, _ = blk
-            return body(carry, (kblk, vblk, kv_pos, None))
-        (acc, m, l), _ = lax.scan(body_noseg, init, blks)
-    else:
-        def body_seg(carry, blk):
-            kblk, vblk, kv_pos, kv_seg = blk
-            return body(carry, (kblk, vblk, kv_pos, kv_seg))
-        (acc, m, l), _ = lax.scan(body_seg, init, blks)
 
+    def step(carry, blk):
+        kblk, vblk, kv_pos, valid, kv_seg = blk
+        return body(
+            carry,
+            (kblk, vblk, kv_pos, valid, kv_seg if kv_seg_all is not None else None),
+        )
+
+    (acc, m, l), _ = lax.scan(jax.checkpoint(step), init, blks)
+    return acc, m, l
+
+
+def flash_attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    block_kv: int = DEFAULT_BLOCK_KV,
+) -> jax.Array:
+    b, sq, n, d = q.shape
+    acc, m, l = blockwise_attention_stats(
+        q, k, v, causal=causal, segment_ids=segment_ids, block_kv=block_kv
+    )
     out = acc / jnp.maximum(l[..., None], 1e-30)
     return out.reshape(b, sq, n, d).astype(q.dtype)

@@ -37,69 +37,29 @@ import jax.numpy as jnp
 from jax import lax
 
 
-DEFAULT_RING_BLOCK_KV = 512
+from neuronx_distributed_llama3_2_tpu.kernels.flash_attention import (
+    DEFAULT_BLOCK_KV,
+    blockwise_attention_stats,
+)
 
 
 def _chunk_attn_stats(
-    q, k, v, q_off, kv_off, causal, kv_len, block_kv=DEFAULT_RING_BLOCK_KV
+    q, k, v, q_off, kv_off, causal, kv_len, block_kv=DEFAULT_BLOCK_KV
 ):
-    """Blockwise (flash-style) attention of local q against one k/v chunk,
-    returning the combinable online-softmax triple (acc, m, l).
-
-    q (B, Sq, N, D); k/v (B, Skv, Nkv, D); positions are global:
-    ``q_off + i`` for query i, ``kv_off + j`` for key j. The inner loop
-    scans kv in ``block_kv`` tiles so peak memory per ring step is
-    O(Sq · block_kv), not O(Sq · Skv) — without this the ring would undo
-    the long-context memory win it exists for.
-    """
-    b, sq, n, d = q.shape
-    skv, nkv = k.shape[1], k.shape[2]
-    group = n // nkv
-    scale = d ** -0.5
-    NEG = jnp.float32(-1e30)
-
-    qg = q.reshape(b, sq, nkv, group, d).astype(jnp.float32) * scale
-    q_pos = q_off + lax.iota(jnp.int32, sq)
-
-    block_kv = min(block_kv, skv)
-    nblk = -(-skv // block_kv)
-    pad = nblk * block_kv - skv
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    if pad:
-        kf = jnp.pad(kf, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        vf = jnp.pad(vf, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    kb = jnp.moveaxis(kf.reshape(b, nblk, block_kv, nkv, d), 1, 0)
-    vb = jnp.moveaxis(vf.reshape(b, nblk, block_kv, nkv, d), 1, 0)
-    pos_b = (kv_off + lax.iota(jnp.int32, nblk * block_kv)).reshape(nblk, block_kv)
-    valid_b = (lax.iota(jnp.int32, nblk * block_kv) < skv).reshape(nblk, block_kv)
-
-    def body(carry, blk):
-        acc, m, l = carry
-        kblk, vblk, kv_pos, valid = blk
-        s = jnp.einsum("bsngd,btnd->bsngt", qg, kblk)
-        mask = valid[None, :]
-        if causal:
-            mask = mask & (kv_pos[None, :] <= q_pos[:, None])
-        if kv_len is not None:
-            mask = mask & (kv_pos < kv_len)[None, :]
-        mask = mask[None, :, None, None, :]
-        s = jnp.where(mask, s, NEG)
-        m_blk = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        alpha = jnp.exp(m - m_new)
-        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
-        l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum("bsngt,btnd->bsngd", p, vblk)
-        return (acc, m_new, l), None
-
-    init = (
-        jnp.zeros((b, sq, nkv, group, d), jnp.float32),
-        jnp.full((b, sq, nkv, group), NEG),
-        jnp.zeros((b, sq, nkv, group), jnp.float32),
+    """One ring step's stats: local q against one visiting k/v chunk at
+    global offsets (q_off, kv_off). Delegates to the shared blockwise
+    online-softmax primitive (kernels/flash_attention.py) so the delicate
+    numerics live in exactly one place; the inner block loop keeps memory
+    at O(Sq · block_kv) per ring step in forward AND backward (each block
+    step is checkpointed there)."""
+    return blockwise_attention_stats(
+        q, k, v,
+        causal=causal,
+        q_off=q_off,
+        kv_off=kv_off,
+        kv_len=kv_len,
+        block_kv=block_kv,
     )
-    (acc, m, l), _ = lax.scan(body, init, (kb, vb, pos_b, valid_b))
-    return acc, m, l
 
 
 def ring_attention(
@@ -109,6 +69,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     kv_len: Optional[int] = None,
+    block_kv: int = DEFAULT_BLOCK_KV,
 ) -> jax.Array:
     """Exact attention over the cp-sharded sequence (call under shard_map
     manual over ``axis_name``). q/k/v are the local chunks (B, S/cp, N, D) /
@@ -141,6 +102,7 @@ def ring_attention(
             kv_off=src * s_loc,
             causal=causal,
             kv_len=kv_len,
+            block_kv=block_kv,
         )
 
     def step(carry, r):
@@ -170,6 +132,7 @@ def ring_attention_sharded(
     mesh,
     axis_name: str,
     causal: bool = True,
+    block_kv: int = DEFAULT_BLOCK_KV,
 ) -> jax.Array:
     """Global-view entry point: q/k/v (B, S, N, D) with S sharded over
     ``axis_name``; wraps :func:`ring_attention` in a partial-manual
@@ -181,7 +144,8 @@ def ring_attention_sharded(
     # kv_len=None: the sequence is exactly S with no padding; pass a real
     # length here only when wiring padded-batch support
     fn = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal, kv_len=None
+        ring_attention, axis_name=axis_name, causal=causal, kv_len=None,
+        block_kv=block_kv,
     )
     return jax.shard_map(
         lambda q, k, v: fn(q, k, v),

@@ -1,0 +1,130 @@
+"""Channel-parallel 2D convolutions (the vision path's TP layers).
+
+TPU-native replacement for the reference's conv parallelism
+(``parallel_layers/layers.py``: ``Conv2dWithInputGradAllReduce`` :813,
+``BaseParallelConv`` :904, ``OutputChannelParallelConv2d`` :1033,
+``InputChannelParallelConv2d`` :1134 — the layers backing the Llama-3.2
+11B-Vision image encoder). The torch versions slice per-rank weight shards
+and hand-insert all-reduce/all-gather autograd functions; here they are
+spec-carrying dataclasses like every layer in :mod:`.layers`: global NHWC
+math plus PartitionSpecs, with GSPMD inserting the collectives —
+the output-channel layer leaves its outputs tp-sharded for a following
+input-channel layer exactly like the Column→Row linear pairing.
+
+Layout: NHWC activations and HWIO kernels (the TPU-native conv layout — the
+MXU consumes the (H·W·I, O) contraction directly; the reference's NCHW/OIHW
+is a torch convention, not a hardware one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+    Params,
+    _activation_spec,
+    constrain,
+    default_kernel_init,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.state import TP_AXIS
+
+IntPair = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntPair) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv_spec(y: jax.Array, channel_axis) -> P:
+    # NHWC activations: batch over dp axes, spatial unsharded, channels last
+    return _activation_spec(y, channel_axis)
+
+
+@dataclasses.dataclass(frozen=True)
+class _ParallelConv2d:
+    """Shared math for both channel-parallel variants (reference
+    BaseParallelConv layers.py:904)."""
+
+    in_channels: int
+    out_channels: int
+    kernel_size: IntPair
+    stride: IntPair = 1
+    padding: IntPair = 0
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    kernel_init: Callable = default_kernel_init
+
+    def _kernel_shape(self) -> Tuple[int, int, int, int]:
+        kh, kw = _pair(self.kernel_size)
+        return (kh, kw, self.in_channels, self.out_channels)  # HWIO
+
+    def init(self, key: jax.Array) -> Params:
+        params = {"kernel": self.kernel_init(key, self._kernel_shape(), self.dtype)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.out_channels,), self.dtype)
+        return params
+
+    def _conv(self, x: jax.Array, kernel: jax.Array) -> jax.Array:
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        return lax.conv_general_dilated(
+            x,
+            kernel,
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class OutputChannelParallelConv2d(_ParallelConv2d):
+    """Conv2d sharded along *output* channels (reference layers.py:1033).
+
+    ``gather_output`` replicates the result over tp; otherwise the channel
+    dim stays tp-sharded for a following :class:`InputChannelParallelConv2d`
+    (the conv analogue of Column→Row linear chaining)."""
+
+    gather_output: bool = False
+
+    def specs(self) -> Params:
+        s = {"kernel": P(None, None, None, TP_AXIS)}
+        if self.use_bias:
+            s["bias"] = P(TP_AXIS)
+        return s
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        y = self._conv(x, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        return constrain(
+            y, _conv_spec(y, None if self.gather_output else TP_AXIS)
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class InputChannelParallelConv2d(_ParallelConv2d):
+    """Conv2d sharded along *input* channels (reference layers.py:1134).
+
+    Expects its input's channel dim tp-sharded (``input_is_parallel``, e.g.
+    the output of an OutputChannelParallelConv2d); the contraction produces
+    partial sums that GSPMD all-reduces — the role of the reference's
+    ``Conv2dWithInputGradAllReduce`` (layers.py:813) plus its output
+    all-reduce, without the hand-written autograd."""
+
+    def specs(self) -> Params:
+        s = {"kernel": P(None, None, TP_AXIS, None)}
+        if self.use_bias:
+            s["bias"] = P(None)
+        return s
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        y = self._conv(x, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        return constrain(y, _conv_spec(y, None))

@@ -1,0 +1,84 @@
+"""Channel-parallel conv tests (reference layers.py:1033,1134 — the vision
+path TP layers; VERDICT coverage row #10)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.conv import (
+    InputChannelParallelConv2d,
+    OutputChannelParallelConv2d,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.layers import shard_pytree
+
+
+def _x(b=2, h=8, w=8, c=16, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal((b, h, w, c)), jnp.float32
+    )
+
+
+def _dense(layer, params, x):
+    """Un-meshed single-device execution as the oracle."""
+    return layer(params, x)
+
+
+def test_output_parallel_matches_dense_under_tp():
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size=4)
+    layer = OutputChannelParallelConv2d(
+        16, 32, kernel_size=3, padding=1, gather_output=True
+    )
+    params = layer.init(jax.random.key(0))
+    x = _x()
+    ref = _dense(layer, params, x)
+    sharded = shard_pytree(params, layer.specs())
+    out = jax.jit(layer.__call__)(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_conv_pair_column_row_chaining():
+    """Output-parallel -> input-parallel composes without a gather between
+    (the conv analogue of Column->Row linear, and the reason gather_output
+    defaults off)."""
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size=4)
+    c1 = OutputChannelParallelConv2d(16, 32, kernel_size=3, padding=1)
+    c2 = InputChannelParallelConv2d(32, 8, kernel_size=1)
+    p1, p2 = c1.init(jax.random.key(1)), c2.init(jax.random.key(2))
+    x = _x()
+    ref = _dense(c2, p2, _dense(c1, p1, x))
+    s1 = shard_pytree(p1, c1.specs())
+    s2 = shard_pytree(p2, c2.specs())
+    out = jax.jit(lambda a, b, x: c2(b, c1(a, x)))(s1, s2, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # intermediate channel dim is genuinely tp-sharded
+    mid = jax.jit(c1.__call__)(s1, x)
+    assert mid.sharding.spec[-1] == "tp"
+
+
+def test_conv_grads_match_dense():
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size=2)
+    c1 = OutputChannelParallelConv2d(8, 16, kernel_size=3, padding=1)
+    c2 = InputChannelParallelConv2d(16, 4, kernel_size=1)
+    p1, p2 = c1.init(jax.random.key(3)), c2.init(jax.random.key(4))
+    x = _x(b=4, c=8, seed=5)  # batch divisible by dp=4 (8 devices / tp=2)
+
+    def loss(p1, p2, x):
+        return jnp.sum(c2(p2, c1(p1, x)) ** 2)
+
+    ref = jax.grad(loss, argnums=(0, 1))(p1, p2, x)
+    s1, s2 = shard_pytree(p1, c1.specs()), shard_pytree(p2, c2.specs())
+    got = jax.jit(jax.grad(loss, argnums=(0, 1)))(s1, s2, x)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_stride_and_rect_kernel():
+    layer = OutputChannelParallelConv2d(
+        4, 8, kernel_size=(3, 1), stride=(2, 1), padding=(1, 0),
+        gather_output=True,
+    )
+    params = layer.init(jax.random.key(6))
+    out = layer(params, _x(c=4))
+    assert out.shape == (2, 4, 8, 8)
