@@ -308,13 +308,37 @@ class LlamaAttention:
     ) -> jax.Array:
         c = self.config
         b = x.shape[0]
-        q, k, v = self._qkv()(params["qkv"], x)
+        qkv_layer = self._qkv()
+        q, k, v = qkv_layer(params["qkv"], x)
         s = q.shape[1]  # global seq len (post SP all-gather under GSPMD)
         q = q.reshape(b, s, c.num_heads, c.head_dim)
         k = k.reshape(b, s, c.num_kv_heads, c.head_dim)
         v = v.reshape(b, s, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, sin, cos, positions)
         k = apply_rope(k, sin, cos, positions)
+
+        # tp > kv_heads: repeat KV heads to tp granularity so the attention
+        # activations shard 1 head/device instead of full replication — the
+        # GSPMD form of the reference's kv_size_multiplier replication
+        # (qkv_linear.py:454); the repeat is on *activations*, so the single
+        # stored kernel receives the summed gradient of all replicas
+        # automatically (the reference needs KV replica-group all-reduces,
+        # qkv_linear.py:250-256)
+        m = qkv_layer.kv_repeat_factor()
+        if m > 1:
+            # mirror _activation_spec: keep the sequence dim on cp when
+            # context parallelism is on (a None here would force an
+            # all-gather of the full sequence right before ring attention)
+            seq_axis = (
+                parallel_state.CP_AXIS
+                if parallel_state.model_parallel_is_initialized()
+                and parallel_state.get_parallel_state().context_parallel_size > 1
+                else None
+            )
+            k = jnp.repeat(k, m, axis=2)
+            v = jnp.repeat(v, m, axis=2)
+            k = constrain(k, P(BATCH_AXES, seq_axis, TP_AXIS, None))
+            v = constrain(v, P(BATCH_AXES, seq_axis, TP_AXIS, None))
 
         # remat-saved activations are stored flattened to (B, S, N·D): with
         # head_dim < 128 the (…, N, D) layout pads D to the 128-lane tile and

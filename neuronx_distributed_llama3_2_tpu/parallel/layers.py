@@ -267,6 +267,30 @@ class GQAQKVColumnParallelLinear:
     def _kv_sharded(self) -> bool:
         return self.num_kv_heads % self._tp() == 0
 
+    def _kv_flat_sharded(self) -> bool:
+        """tp > kv_heads but tp divides the flat kv projection width: the
+        K/V kernels shard over the flat (kv·head_dim) output dim — every
+        device stores 1/tp of the weight instead of a full replica (the
+        GSPMD analogue of the reference's kv_size_multiplier resharding,
+        qkv_linear.py:454; the consumer re-shards the activation by
+        repeating heads, see LlamaAttention)."""
+        tp = self._tp()
+        return (
+            not self._kv_sharded()
+            and tp % self.num_kv_heads == 0
+            and (self.num_kv_heads * self.head_dim) % tp == 0
+            # the consumer repeats KV heads to exactly tp, so Q heads must
+            # also shard over tp or the GQA group count collapses to zero
+            and self.num_heads % tp == 0
+        )
+
+    def kv_repeat_factor(self) -> int:
+        """How many times the consumer must repeat KV heads so the attention
+        activations shard 1 head/device (1 = no repeat needed). The public
+        face of the flat-sharding decision — keeps all sharding arithmetic
+        inside this layer."""
+        return self._tp() // self.num_kv_heads if self._kv_flat_sharded() else 1
+
     def init(self, key: jax.Array) -> Params:
         kq, kk, kv = jax.random.split(key, 3)
         q_out = self.num_heads * self.head_dim
@@ -283,7 +307,10 @@ class GQAQKVColumnParallelLinear:
         return params
 
     def specs(self) -> Params:
-        kv_spec = P(None, TP_AXIS) if self._kv_sharded() else P(None, None)
+        if self._kv_sharded() or self._kv_flat_sharded():
+            kv_spec, kv_bias = P(None, TP_AXIS), P(TP_AXIS)
+        else:
+            kv_spec, kv_bias = P(None, None), P(None)
         s = {
             "q_kernel": P(None, TP_AXIS),
             "k_kernel": kv_spec,
@@ -291,7 +318,6 @@ class GQAQKVColumnParallelLinear:
         }
         if self.use_bias:
             s["q_bias"] = P(TP_AXIS)
-            kv_bias = P(TP_AXIS) if self._kv_sharded() else P(None)
             s["k_bias"] = kv_bias
             s["v_bias"] = kv_bias
         return s
@@ -305,7 +331,11 @@ class GQAQKVColumnParallelLinear:
             k = k + params["k_bias"]
             v = v + params["v_bias"]
         q = constrain(q, _activation_spec(q, TP_AXIS))
-        kv_axis = TP_AXIS if self._kv_sharded() else None
+        # flat-sharded kv keeps the projection tp-sharded too (the consumer
+        # repeats heads and re-shards; see LlamaAttention)
+        kv_axis = (
+            TP_AXIS if self._kv_sharded() or self._kv_flat_sharded() else None
+        )
         k = constrain(k, _activation_spec(k, kv_axis))
         v = constrain(v, _activation_spec(v, kv_axis))
         return q, k, v
