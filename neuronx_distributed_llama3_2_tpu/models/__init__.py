@@ -8,3 +8,10 @@ from neuronx_distributed_llama3_2_tpu.models.mixtral import (  # noqa: F401
     MixtralConfig,
     MixtralForCausalLM,
 )
+from neuronx_distributed_llama3_2_tpu.models.mllama import (  # noqa: F401
+    MllamaConfig,
+    MllamaForConditionalGeneration,
+    MllamaTextConfig,
+    MllamaVisionConfig,
+    mllama_params_from_hf,
+)

@@ -1,0 +1,993 @@
+"""Llama-3.2 Vision (Mllama): multimodal model family.
+
+TPU-native implementation of the 11B-Vision architecture named by
+BASELINE.json ("Llama-3.2 11B-Vision multimodal"). The reference repo ships
+no vision modeling code — its conv TP layers (``parallel_layers/layers.py``
+:1033/:1134) exist *for* this model family; we build the whole family:
+
+- **Vision encoder**: tiled ViT — channel-parallel patch conv, gated
+  aspect-ratio/tile/position embeddings, pre/post layernorm, N local +
+  M tanh-gated global transformer layers, intermediate-feature collection.
+- **Text decoder**: Llama self-attention layers (reused from
+  :mod:`.llama`) interleaved with tanh-gated cross-attention layers
+  (q/k-normed GQA attending over projected vision tokens).
+- **MllamaForConditionalGeneration**: vision encoder → multimodal
+  projector → text decoder with cross-attention masking.
+
+Semantics match HF ``transformers`` Mllama (modeling_mllama.py) — gating
+formulas (``(1-tanh(g))·pos + tanh(g)·tile`` :146-163, ``π/4``-init encoder
+gates :293-313, zero-init cross-attn gates :673-724), the 8-multiple patch
+padding (:1070-1076), intermediate states collected *after* each local layer
+(:353-361), and the cross-attention full-text-row mask (:48-73) — verified
+by logits-parity tests against the HF implementation
+(tests/test_mllama.py).
+
+TP mapping: vision attention/MLP shard like text attention/MLP
+(Column→Row); the patch conv is an OutputChannelParallelConv2d
+(parallel/conv.py) with gathered output; embeddings/gates replicate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LlamaConfig,
+    LlamaDecoderLayer,
+    RMSNorm,
+    precompute_rope,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.conv import (
+    OutputChannelParallelConv2d,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+    RowParallelLinear,
+    default_kernel_init,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.loss import (
+    fused_linear_cross_entropy,
+)
+
+Params = Dict[str, Any]
+
+NEG = jnp.float32(-1e30)
+
+
+# ---------------------------------------------------------------------------
+# configs
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MllamaVisionConfig:
+    """HF MllamaVisionConfig counterpart (configuration_mllama.py)."""
+
+    hidden_size: int = 1280
+    intermediate_size: int = 5120
+    num_hidden_layers: int = 32
+    num_global_layers: int = 8
+    attention_heads: int = 16
+    image_size: int = 448
+    patch_size: int = 14
+    num_channels: int = 3
+    max_num_tiles: int = 4
+    max_aspect_ratio_id: int = 8
+    intermediate_layers_indices: Tuple[int, ...] = (3, 7, 15, 23, 30)
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2 + 1
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.attention_heads
+
+    @property
+    def output_dim(self) -> int:
+        # final hidden + one slice per collected intermediate layer
+        return self.hidden_size * (1 + len(self.intermediate_layers_indices))
+
+
+@dataclasses.dataclass(frozen=True)
+class MllamaTextConfig:
+    """HF MllamaTextConfig counterpart: a Llama decoder plus gated
+    cross-attention layers at ``cross_attention_layers`` indices."""
+
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 40
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    cross_attention_layers: Tuple[int, ...] = (3, 8, 13, 18, 23, 28, 33, 38)
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def self_attn_layer_config(self) -> LlamaConfig:
+        """LlamaConfig for the (reused) self-attention decoder layers."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_layers=1,
+            num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim,
+            rope_theta=self.rope_theta,
+            rope_scaling=self.rope_scaling,
+            rms_norm_eps=self.rms_norm_eps,
+            max_seq_len=self.max_seq_len,
+            dtype=self.dtype,
+            remat="none",
+            tie_word_embeddings=False,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class MllamaConfig:
+    vision: MllamaVisionConfig = MllamaVisionConfig()
+    text: MllamaTextConfig = MllamaTextConfig()
+
+
+# ---------------------------------------------------------------------------
+# small building blocks
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LayerNorm:
+    """Standard layernorm with bias (the vision tower is pre/post-LN ViT;
+    the text side keeps RMSNorm)."""
+
+    dim: int
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    def init(self, key) -> Params:
+        return {
+            "scale": jnp.ones((self.dim,), jnp.float32),
+            "bias": jnp.zeros((self.dim,), jnp.float32),
+        }
+
+    def specs(self) -> Params:
+        return {"scale": P(None), "bias": P(None)}
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        h = x.astype(jnp.float32)
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(h - mu), axis=-1, keepdims=True)
+        h = (h - mu) * jax.lax.rsqrt(var + self.eps)
+        return (h * params["scale"] + params["bias"]).astype(self.dtype)
+
+
+def _mha(q, k, v, bias, num_heads, head_dim):
+    """Dense multi-head attention with an additive bias mask (the vision
+    tower's sequences are ~1K tokens per tile-set; dense is the right call
+    on the MXU). q/k/v (B, S, H_flat)."""
+    b, sq, _ = q.shape
+    skv = k.shape[1]
+    q = q.reshape(b, sq, num_heads, head_dim)
+    k = k.reshape(b, skv, num_heads, head_dim)
+    v = v.reshape(b, skv, num_heads, head_dim)
+    scores = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
+    scores = scores * (head_dim ** -0.5)
+    if bias is not None:
+        scores = scores + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bnqk,bknd->bqnd", probs, v)
+    return out.reshape(b, sq, num_heads * head_dim)
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionAttention:
+    """MllamaVisionAttention (modeling_mllama.py:219): MHA, no bias terms,
+    q/k/v Column-parallel + o Row-parallel."""
+
+    config: MllamaVisionConfig
+
+    def _proj(self) -> ColumnParallelLinear:
+        c = self.config
+        return ColumnParallelLinear(c.hidden_size, c.hidden_size, dtype=c.dtype)
+
+    def _o(self) -> RowParallelLinear:
+        c = self.config
+        return RowParallelLinear(c.hidden_size, c.hidden_size, dtype=c.dtype)
+
+    def init(self, key) -> Params:
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        return {
+            "q": self._proj().init(kq),
+            "k": self._proj().init(kk),
+            "v": self._proj().init(kv),
+            "o": self._o().init(ko),
+        }
+
+    def specs(self) -> Params:
+        return {
+            "q": self._proj().specs(),
+            "k": self._proj().specs(),
+            "v": self._proj().specs(),
+            "o": self._o().specs(),
+        }
+
+    def __call__(self, params: Params, x: jax.Array, bias) -> jax.Array:
+        c = self.config
+        q = self._proj()(params["q"], x)
+        k = self._proj()(params["k"], x)
+        v = self._proj()(params["v"], x)
+        attn = _mha(q, k, v, bias, c.attention_heads, c.head_dim)
+        return self._o()(params["o"], attn)
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionMLP:
+    """CLIP-style MLP: fc1/gelu/fc2, with biases (modeling_mllama.py:164)."""
+
+    config: MllamaVisionConfig
+
+    def _fc1(self) -> ColumnParallelLinear:
+        c = self.config
+        return ColumnParallelLinear(
+            c.hidden_size, c.intermediate_size, use_bias=True, dtype=c.dtype
+        )
+
+    def _fc2(self) -> RowParallelLinear:
+        c = self.config
+        return RowParallelLinear(
+            c.intermediate_size, c.hidden_size, use_bias=True, dtype=c.dtype
+        )
+
+    def init(self, key) -> Params:
+        k1, k2 = jax.random.split(key)
+        return {"fc1": self._fc1().init(k1), "fc2": self._fc2().init(k2)}
+
+    def specs(self) -> Params:
+        return {"fc1": self._fc1().specs(), "fc2": self._fc2().specs()}
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        h = self._fc1()(params["fc1"], x)
+        h = jax.nn.gelu(h.astype(jnp.float32), approximate=False).astype(x.dtype)
+        return self._fc2()(params["fc2"], h)
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionEncoderLayer:
+    """Pre-LN ViT block; global layers tanh-gate both residual branches
+    (gates init pi/4, modeling_mllama.py:274-313)."""
+
+    config: MllamaVisionConfig
+    is_gated: bool = False
+
+    def _ln(self) -> LayerNorm:
+        c = self.config
+        return LayerNorm(c.hidden_size, c.norm_eps, c.dtype)
+
+    def init(self, key) -> Params:
+        ka, km = jax.random.split(key)
+        p = {
+            "input_layernorm": self._ln().init(key),
+            "self_attn": VisionAttention(self.config).init(ka),
+            "post_attention_layernorm": self._ln().init(key),
+            "mlp": VisionMLP(self.config).init(km),
+        }
+        if self.is_gated:
+            p["gate_attn"] = jnp.full((1,), math.pi / 4, jnp.float32)
+            p["gate_ffn"] = jnp.full((1,), math.pi / 4, jnp.float32)
+        return p
+
+    def specs(self) -> Params:
+        s = {
+            "input_layernorm": self._ln().specs(),
+            "self_attn": VisionAttention(self.config).specs(),
+            "post_attention_layernorm": self._ln().specs(),
+            "mlp": VisionMLP(self.config).specs(),
+        }
+        if self.is_gated:
+            s["gate_attn"] = P(None)
+            s["gate_ffn"] = P(None)
+        return s
+
+    def __call__(self, params: Params, x: jax.Array, bias) -> jax.Array:
+        h = VisionAttention(self.config)(
+            params["self_attn"], self._ln()(params["input_layernorm"], x), bias
+        )
+        if self.is_gated:
+            h = jnp.tanh(params["gate_attn"]) * h
+        x = x + h
+        h = VisionMLP(self.config)(
+            params["mlp"], self._ln()(params["post_attention_layernorm"], x)
+        )
+        if self.is_gated:
+            h = jnp.tanh(params["gate_ffn"]) * h
+        return x + h
+
+
+# ---------------------------------------------------------------------------
+# vision model
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MllamaVisionModel:
+    """Tiled ViT encoder (modeling_mllama.py:943): returns
+    (B, num_media, tiles, patches+1, output_dim) features — final hidden
+    concatenated with the configured intermediate layer states."""
+
+    config: MllamaVisionConfig
+
+    def _patch_conv(self) -> OutputChannelParallelConv2d:
+        c = self.config
+        return OutputChannelParallelConv2d(
+            c.num_channels, c.hidden_size, kernel_size=c.patch_size,
+            stride=c.patch_size, use_bias=False, gather_output=True,
+            dtype=c.dtype,
+        )
+
+    def init(self, key) -> Params:
+        c = self.config
+        keys = jax.random.split(key, 8 + c.num_hidden_layers + c.num_global_layers)
+        scale = c.hidden_size ** -0.5
+        p: Params = {
+            "patch_embedding": self._patch_conv().init(keys[0]),
+            "class_embedding": scale
+            * jax.random.normal(keys[1], (c.hidden_size,), jnp.float32),
+            "gated_positional_embedding": {
+                "embedding": scale
+                * jax.random.normal(
+                    keys[2], (c.num_patches, c.hidden_size), jnp.float32
+                ),
+                "tile_embedding": default_kernel_init(
+                    keys[3],
+                    (
+                        c.max_aspect_ratio_id + 1,
+                        c.max_num_tiles * c.num_patches * c.hidden_size,
+                    ),
+                    jnp.float32,
+                ),
+                "gate": jnp.zeros((1,), jnp.float32),
+            },
+            "pre_tile_positional_embedding": {
+                "embedding": default_kernel_init(
+                    keys[4],
+                    (c.max_aspect_ratio_id + 1, c.max_num_tiles * c.hidden_size),
+                    jnp.float32,
+                ),
+                "gate": jnp.zeros((1,), jnp.float32),
+            },
+            "post_tile_positional_embedding": {
+                "embedding": default_kernel_init(
+                    keys[5],
+                    (c.max_aspect_ratio_id + 1, c.max_num_tiles * c.hidden_size),
+                    jnp.float32,
+                ),
+                "gate": jnp.zeros((1,), jnp.float32),
+            },
+            "layernorm_pre": LayerNorm(c.hidden_size, dtype=c.dtype).init(keys[6]),
+            "layernorm_post": LayerNorm(c.hidden_size, dtype=c.dtype).init(keys[7]),
+            "transformer": [
+                VisionEncoderLayer(c, is_gated=False).init(keys[8 + i])
+                for i in range(c.num_hidden_layers)
+            ],
+            "global_transformer": [
+                VisionEncoderLayer(c, is_gated=True).init(
+                    keys[8 + c.num_hidden_layers + i]
+                )
+                for i in range(c.num_global_layers)
+            ],
+        }
+        return p
+
+    def specs(self) -> Params:
+        c = self.config
+        rep2 = {"embedding": P(None, None), "gate": P(None)}
+        return {
+            "patch_embedding": self._patch_conv().specs(),
+            "class_embedding": P(None),
+            "gated_positional_embedding": {
+                "embedding": P(None, None),
+                "tile_embedding": P(None, None),
+                "gate": P(None),
+            },
+            "pre_tile_positional_embedding": dict(rep2),
+            "post_tile_positional_embedding": dict(rep2),
+            "layernorm_pre": LayerNorm(c.hidden_size).specs(),
+            "layernorm_post": LayerNorm(c.hidden_size).specs(),
+            "transformer": [
+                VisionEncoderLayer(c, is_gated=False).specs()
+                for _ in range(c.num_hidden_layers)
+            ],
+            "global_transformer": [
+                VisionEncoderLayer(c, is_gated=True).specs()
+                for _ in range(c.num_global_layers)
+            ],
+        }
+
+    def _tile_embedding(self, emb_params, hidden, aspect_ratio_ids):
+        """Gated per-tile embedding (modeling_mllama.py:103-124);
+        hidden (BM, tiles, patches, H)."""
+        c = self.config
+        emb = jnp.take(emb_params["embedding"], aspect_ratio_ids, axis=0)
+        emb = emb.reshape(-1, c.max_num_tiles, 1, c.hidden_size)
+        return hidden + jnp.tanh(emb_params["gate"]) * emb
+
+    def _positional_embedding(self, pe, hidden, aspect_ratio_ids):
+        """(1-tanh g)·pos + tanh g·tile-pos (modeling_mllama.py:146-163)."""
+        c = self.config
+        g = jnp.tanh(pe["gate"])
+        hidden = hidden + (1.0 - g) * pe["embedding"].reshape(
+            1, 1, c.num_patches, c.hidden_size
+        )
+        tile = jnp.take(pe["tile_embedding"], aspect_ratio_ids, axis=0).reshape(
+            -1, c.max_num_tiles, c.num_patches, c.hidden_size
+        )
+        return hidden + g * tile
+
+    def __call__(
+        self,
+        params: Params,
+        pixel_values: jax.Array,       # (B, M, T, C, H, W) torch layout
+        aspect_ratio_ids: jax.Array,   # (B, M)
+        aspect_ratio_mask: jax.Array,  # (B, M, T)
+    ) -> jax.Array:
+        c = self.config
+        b, m, t, ch, hgt, wid = pixel_values.shape
+        x = pixel_values.reshape(b * m * t, ch, hgt, wid)
+        # NCHW → NHWC (TPU conv layout)
+        x = jnp.transpose(x, (0, 2, 3, 1)).astype(c.dtype)
+        patches = self._patch_conv()(params["patch_embedding"], x)
+        # (N, H/p, W/p, hidden) → (N, patches, hidden), row-major like
+        # torch's flatten(2) of (N, hidden, H/p, W/p)
+        n_pat = patches.shape[1] * patches.shape[2]
+        hidden = patches.reshape(b * m * t, n_pat, c.hidden_size)
+
+        ar_ids = aspect_ratio_ids.reshape(b * m)
+        hidden = hidden.reshape(b * m, t, n_pat, c.hidden_size)
+        hidden = self._tile_embedding(
+            params["pre_tile_positional_embedding"], hidden, ar_ids
+        )
+
+        # class token
+        cls = jnp.broadcast_to(
+            params["class_embedding"].astype(c.dtype),
+            (b * m * t, 1, c.hidden_size),
+        )
+        hidden = hidden.reshape(b * m * t, n_pat, c.hidden_size)
+        hidden = jnp.concatenate([cls, hidden], axis=1)
+        n_pat += 1
+
+        hidden = hidden.reshape(b * m, t, n_pat, c.hidden_size)
+        hidden = self._positional_embedding(
+            params["gated_positional_embedding"], hidden, ar_ids
+        )
+        hidden = LayerNorm(c.hidden_size, c.norm_eps, c.dtype)(
+            params["layernorm_pre"], hidden
+        )
+
+        # pad patch dim to a multiple of 8 (modeling_mllama.py:1070-1076)
+        npad = (8 - n_pat % 8) % 8
+        if npad:
+            hidden = jnp.pad(hidden, ((0, 0), (0, 0), (0, npad), (0, 0)))
+        tlen = n_pat + npad
+
+        # tile-validity attention bias (modeling_mllama.py:76-101): token i
+        # may attend token j iff both lie in valid (unpadded) positions of
+        # valid tiles
+        amask = aspect_ratio_mask.reshape(b * m, t).astype(jnp.float32)
+        tok_ok = jnp.repeat(amask, tlen, axis=1)  # (BM, T*tlen)
+        pad_pos = jnp.arange(tlen) >= n_pat
+        tok_ok = tok_ok * jnp.where(
+            jnp.tile(pad_pos, (t,)), 0.0, 1.0
+        )[None, :]
+        inv = 1.0 - tok_ok
+        bias = (inv[:, :, None] @ inv[:, None, :]) * NEG  # (BM, S, S)
+        bias = bias[:, None, :, :]  # (BM, 1, S, S)
+
+        hidden = hidden.reshape(b * m, t * tlen, c.hidden_size)
+        intermediates: List[jax.Array] = []
+        for i, lp in enumerate(params["transformer"]):
+            hidden = VisionEncoderLayer(c, is_gated=False)(lp, hidden, bias)
+            if i in c.intermediate_layers_indices:
+                intermediates.append(hidden)
+
+        hidden = LayerNorm(c.hidden_size, c.norm_eps, c.dtype)(
+            params["layernorm_post"], hidden
+        )
+        hidden = hidden.reshape(b * m, t, tlen, c.hidden_size)
+        hidden = self._tile_embedding(
+            params["post_tile_positional_embedding"], hidden, ar_ids
+        )
+        hidden = hidden.reshape(b * m, t * tlen, c.hidden_size)
+        for lp in params["global_transformer"]:
+            hidden = VisionEncoderLayer(c, is_gated=True)(lp, hidden, bias)
+
+        # strip padding, collect (final, intermediates)
+        hidden = hidden.reshape(b * m, t, tlen, c.hidden_size)[:, :, :n_pat]
+        inter = jnp.stack(intermediates, axis=-1)  # (BM, S, H, K)
+        inter = inter.reshape(b * m, t, tlen, -1)[:, :, :n_pat]
+        out = jnp.concatenate(
+            [hidden.reshape(b * m, t, n_pat, c.hidden_size), inter], axis=-1
+        )
+        return out.reshape(b, m, t, n_pat, c.output_dim)
+
+
+# ---------------------------------------------------------------------------
+# text side: cross-attention
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TextCrossAttention:
+    """MllamaTextCrossAttention (modeling_mllama.py:385): GQA over vision
+    tokens, per-head-dim RMSNorm on q and k, no rope."""
+
+    config: MllamaTextConfig
+
+    def _q(self) -> ColumnParallelLinear:
+        c = self.config
+        return ColumnParallelLinear(c.hidden_size, c.num_heads * c.head_dim, dtype=c.dtype)
+
+    def _kv(self) -> ColumnParallelLinear:
+        c = self.config
+        return ColumnParallelLinear(
+            c.hidden_size, c.num_kv_heads * c.head_dim, dtype=c.dtype
+        )
+
+    def _o(self) -> RowParallelLinear:
+        c = self.config
+        return RowParallelLinear(c.num_heads * c.head_dim, c.hidden_size, dtype=c.dtype)
+
+    def _norm(self) -> RMSNorm:
+        return RMSNorm(self.config.head_dim, self.config.rms_norm_eps, self.config.dtype)
+
+    def init(self, key) -> Params:
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        return {
+            "q": self._q().init(kq),
+            "k": self._kv().init(kk),
+            "v": self._kv().init(kv),
+            "o": self._o().init(ko),
+            "q_norm": self._norm().init(key),
+            "k_norm": self._norm().init(key),
+        }
+
+    def specs(self) -> Params:
+        return {
+            "q": self._q().specs(),
+            "k": self._kv().specs(),
+            "v": self._kv().specs(),
+            "o": self._o().specs(),
+            "q_norm": self._norm().specs(),
+            "k_norm": self._norm().specs(),
+        }
+
+    def __call__(self, params, x, vision_tokens, bias) -> jax.Array:
+        c = self.config
+        b, sq, _ = x.shape
+        skv = vision_tokens.shape[1]
+        q = self._q()(params["q"], x).reshape(b, sq, c.num_heads, c.head_dim)
+        k = self._kv()(params["k"], vision_tokens).reshape(
+            b, skv, c.num_kv_heads, c.head_dim
+        )
+        v = self._kv()(params["v"], vision_tokens).reshape(
+            b, skv, c.num_kv_heads, c.head_dim
+        )
+        q = self._norm()(params["q_norm"], q)
+        k = self._norm()(params["k_norm"], k)
+        group = c.num_heads // c.num_kv_heads
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+        attn = _mha(
+            q.reshape(b, sq, -1),
+            k.reshape(b, skv, -1),
+            v.reshape(b, skv, -1),
+            bias,
+            c.num_heads,
+            c.head_dim,
+        )
+        return self._o()(params["o"], attn)
+
+
+@dataclasses.dataclass(frozen=True)
+class CrossAttentionDecoderLayer:
+    """MllamaCrossAttentionDecoderLayer (modeling_mllama.py:673): zero-init
+    tanh gates on both branches; MLP output rows fully masked out for text
+    rows that attend no vision token."""
+
+    config: MllamaTextConfig
+
+    def _norm(self) -> RMSNorm:
+        c = self.config
+        return RMSNorm(c.hidden_size, c.rms_norm_eps, c.dtype)
+
+    def _mlp_cfg(self):
+        return self.config.self_attn_layer_config()
+
+    def init(self, key) -> Params:
+        from neuronx_distributed_llama3_2_tpu.models.llama import LlamaMLP
+
+        ka, km = jax.random.split(key)
+        return {
+            "input_layernorm": self._norm().init(key),
+            "cross_attn": TextCrossAttention(self.config).init(ka),
+            "cross_attn_attn_gate": jnp.zeros((1,), jnp.float32),
+            "post_attention_layernorm": self._norm().init(key),
+            "mlp": LlamaMLP(self._mlp_cfg()).init(km),
+            "cross_attn_mlp_gate": jnp.zeros((1,), jnp.float32),
+        }
+
+    def specs(self) -> Params:
+        from neuronx_distributed_llama3_2_tpu.models.llama import LlamaMLP
+
+        return {
+            "input_layernorm": self._norm().specs(),
+            "cross_attn": TextCrossAttention(self.config).specs(),
+            "cross_attn_attn_gate": P(None),
+            "post_attention_layernorm": self._norm().specs(),
+            "mlp": LlamaMLP(self._mlp_cfg()).specs(),
+            "cross_attn_mlp_gate": P(None),
+        }
+
+    def __call__(self, params, x, vision_tokens, bias, full_row_mask):
+        from neuronx_distributed_llama3_2_tpu.models.llama import LlamaMLP
+
+        h = TextCrossAttention(self.config)(
+            params["cross_attn"],
+            self._norm()(params["input_layernorm"], x),
+            vision_tokens,
+            bias,
+        )
+        x = x + jnp.tanh(params["cross_attn_attn_gate"]) * h
+        h = LlamaMLP(self._mlp_cfg())(
+            params["mlp"], self._norm()(params["post_attention_layernorm"], x)
+        )
+        if full_row_mask is not None:
+            # (B, 1, S, 1) head-broadcast mask → (B, S, 1) for the hidden
+            # stream (HF applies [:, 0], modeling_mllama.py:720)
+            h = full_row_mask[:, 0] * h
+        return x + jnp.tanh(params["cross_attn_mlp_gate"]) * h
+
+
+def prepare_cross_attention_mask(
+    cross_attention_mask: jax.Array,  # (B, S_text, M, T) 1=attend
+    num_vision_tokens: int,
+):
+    """HF _prepare_cross_attention_mask (modeling_mllama.py:48-73): expand
+    per-tile mask to per-vision-token additive bias + the full-text-row
+    mask zeroing rows that attend nothing."""
+    b, s = cross_attention_mask.shape[:2]
+    mask = jnp.repeat(cross_attention_mask, num_vision_tokens, axis=3)
+    mask = mask.reshape(b, s, -1)[:, None, :, :].astype(jnp.float32)
+    bias = (1.0 - mask) * NEG
+    full_row = (bias != NEG).any(axis=-1).astype(jnp.float32)[..., None]
+    bias = bias * full_row
+    return bias, full_row
+
+
+# ---------------------------------------------------------------------------
+# full model
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MllamaForConditionalGeneration:
+    """Vision encoder → projector → Llama decoder with interleaved gated
+    cross-attention (modeling_mllama.py:1540). Model-protocol compatible
+    (init/specs/__call__/loss) so trainer/checkpoint layers apply."""
+
+    config: MllamaConfig
+
+    def _self_layer(self) -> LlamaDecoderLayer:
+        return LlamaDecoderLayer(self.config.text.self_attn_layer_config())
+
+    def _embed(self) -> ParallelEmbedding:
+        t = self.config.text
+        # +8 special tokens (HF reserves extra rows for the image token etc.)
+        return ParallelEmbedding(t.vocab_size + 8, t.hidden_size, dtype=t.dtype)
+
+    def _projector(self) -> ColumnParallelLinear:
+        return ColumnParallelLinear(
+            self.config.vision.output_dim,
+            self.config.text.hidden_size,
+            use_bias=True,
+            gather_output=True,
+            dtype=self.config.text.dtype,
+        )
+
+    def _lm_head(self) -> ColumnParallelLinear:
+        t = self.config.text
+        return ColumnParallelLinear(t.hidden_size, t.vocab_size, dtype=t.dtype)
+
+    def init(self, key) -> Params:
+        t = self.config.text
+        keys = jax.random.split(key, t.num_hidden_layers + 5)
+        layers = []
+        for i in range(t.num_hidden_layers):
+            if i in t.cross_attention_layers:
+                layers.append(CrossAttentionDecoderLayer(t).init(keys[i]))
+            else:
+                layers.append(self._self_layer().init(keys[i]))
+        return {
+            "vision_model": MllamaVisionModel(self.config.vision).init(keys[-5]),
+            "multi_modal_projector": self._projector().init(keys[-4]),
+            "embed": self._embed().init(keys[-3]),
+            "layers": layers,
+            "final_norm": RMSNorm(t.hidden_size, t.rms_norm_eps, t.dtype).init(keys[-2]),
+            "lm_head": self._lm_head().init(keys[-1]),
+        }
+
+    def specs(self) -> Params:
+        t = self.config.text
+        layers = []
+        for i in range(t.num_hidden_layers):
+            if i in t.cross_attention_layers:
+                layers.append(CrossAttentionDecoderLayer(t).specs())
+            else:
+                layers.append(self._self_layer().specs())
+        return {
+            "vision_model": MllamaVisionModel(self.config.vision).specs(),
+            "multi_modal_projector": self._projector().specs(),
+            "embed": self._embed().specs(),
+            "layers": layers,
+            "final_norm": RMSNorm(t.hidden_size, t.rms_norm_eps, t.dtype).specs(),
+            "lm_head": self._lm_head().specs(),
+        }
+
+    def encode_images(
+        self, params, pixel_values, aspect_ratio_ids, aspect_ratio_mask
+    ) -> jax.Array:
+        """(B, M·T·P, text_hidden) projected vision tokens."""
+        v = MllamaVisionModel(self.config.vision)(
+            params["vision_model"], pixel_values, aspect_ratio_ids, aspect_ratio_mask
+        )
+        b = v.shape[0]
+        proj = self._projector()(
+            params["multi_modal_projector"],
+            v.astype(self.config.text.dtype),
+        )
+        return proj.reshape(b, -1, self.config.text.hidden_size)
+
+    def __call__(
+        self,
+        params: Params,
+        input_ids: jax.Array,            # (B, S)
+        pixel_values: jax.Array,         # (B, M, T, C, H, W)
+        aspect_ratio_ids: jax.Array,     # (B, M)
+        aspect_ratio_mask: jax.Array,    # (B, M, T)
+        cross_attention_mask: Optional[jax.Array] = None,  # (B, S, M, T)
+    ) -> jax.Array:
+        hidden = self._hidden(
+            params, input_ids, pixel_values, aspect_ratio_ids,
+            aspect_ratio_mask, cross_attention_mask,
+        )
+        return self._lm_head()(params["lm_head"], hidden)
+
+    def _hidden(
+        self, params, input_ids, pixel_values, aspect_ratio_ids,
+        aspect_ratio_mask, cross_attention_mask,
+    ) -> jax.Array:
+        """Final-norm'ed decoder hidden states (pre LM-head)."""
+        t = self.config.text
+        vision_tokens = self.encode_images(
+            params, pixel_values, aspect_ratio_ids, aspect_ratio_mask
+        )
+        bias = full_row = None
+        if cross_attention_mask is not None:
+            bias, full_row = prepare_cross_attention_mask(
+                cross_attention_mask, self.config.vision.num_patches
+            )
+        b, s = input_ids.shape
+        x = self._embed()(params["embed"], input_ids)
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        sin, cos = precompute_rope(t.head_dim, s, t.rope_theta, t.rope_scaling)
+        layer = self._self_layer()
+        for i, lp in enumerate(params["layers"]):
+            if i in t.cross_attention_layers:
+                x = CrossAttentionDecoderLayer(t)(
+                    lp, x, vision_tokens, bias, full_row
+                )
+            else:
+                x = layer(lp, x, sin, cos, positions)
+        return RMSNorm(t.hidden_size, t.rms_norm_eps, t.dtype)(
+            params["final_norm"], x
+        )
+
+    def loss(
+        self,
+        params: Params,
+        input_ids: jax.Array,
+        labels: jax.Array,
+        pixel_values: jax.Array,
+        aspect_ratio_ids: jax.Array,
+        aspect_ratio_mask: jax.Array,
+        cross_attention_mask: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        hidden = self._hidden(
+            params, input_ids, pixel_values, aspect_ratio_ids,
+            aspect_ratio_mask, cross_attention_mask,
+        )
+        # chunked fused CE over pre-head hidden states: the (B, S, vocab)
+        # logits never materialize (same memory discipline as
+        # LlamaForCausalLM.loss_from_hidden)
+        shifted = labels[:, 1:]
+        loss_sum, count = fused_linear_cross_entropy(
+            hidden[:, :-1, :],
+            lambda hc: self._lm_head()(params["lm_head"], hc),
+            shifted,
+            chunk_size=min(512, hidden.shape[1]),
+        )
+        return loss_sum / jnp.maximum(count, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# HF weight conversion
+# ---------------------------------------------------------------------------
+
+def mllama_params_from_hf(state_dict: Dict[str, Any], config: MllamaConfig) -> Params:
+    """HF Mllama state dict → this model's pytree (same role as
+    llama.params_from_hf; torch Linear (out, in) → (in, out))."""
+    import numpy as np
+
+    def t(name):
+        w = state_dict[name]
+        if hasattr(w, "detach"):
+            w = w.detach().cpu().numpy()
+        return np.asarray(w, dtype=np.float32)
+
+    def lin(name):
+        return {"kernel": jnp.asarray(t(name + ".weight").T)}
+
+    def lin_b(name):
+        return {
+            "kernel": jnp.asarray(t(name + ".weight").T),
+            "bias": jnp.asarray(t(name + ".bias")),
+        }
+
+    def ln(name):
+        return {
+            "scale": jnp.asarray(t(name + ".weight")),
+            "bias": jnp.asarray(t(name + ".bias")),
+        }
+
+    def rms(name):
+        return {"scale": jnp.asarray(t(name + ".weight"))}
+
+    vp = "model.vision_model."
+    c = config.vision
+
+    def vis_layer(prefix):
+        p = {
+            "input_layernorm": ln(prefix + "input_layernorm"),
+            "self_attn": {
+                "q": lin(prefix + "self_attn.q_proj"),
+                "k": lin(prefix + "self_attn.k_proj"),
+                "v": lin(prefix + "self_attn.v_proj"),
+                "o": lin(prefix + "self_attn.o_proj"),
+            },
+            "post_attention_layernorm": ln(prefix + "post_attention_layernorm"),
+            "mlp": {
+                "fc1": lin_b(prefix + "mlp.fc1"),
+                "fc2": lin_b(prefix + "mlp.fc2"),
+            },
+        }
+        if prefix.startswith(vp + "global_transformer"):
+            p["gate_attn"] = jnp.asarray(t(prefix + "gate_attn")).reshape(1)
+            p["gate_ffn"] = jnp.asarray(t(prefix + "gate_ffn")).reshape(1)
+        return p
+
+    # patch conv: torch OIHW → HWIO
+    conv_w = t(vp + "patch_embedding.weight")
+    vision: Params = {
+        "patch_embedding": {
+            "kernel": jnp.asarray(np.transpose(conv_w, (2, 3, 1, 0)))
+        },
+        "class_embedding": jnp.asarray(t(vp + "class_embedding")),
+        "gated_positional_embedding": {
+            "embedding": jnp.asarray(t(vp + "gated_positional_embedding.embedding")),
+            "tile_embedding": jnp.asarray(
+                t(vp + "gated_positional_embedding.tile_embedding.weight")
+            ),
+            "gate": jnp.asarray(t(vp + "gated_positional_embedding.gate")).reshape(1),
+        },
+        "pre_tile_positional_embedding": {
+            "embedding": jnp.asarray(
+                t(vp + "pre_tile_positional_embedding.embedding.weight")
+            ),
+            "gate": jnp.asarray(
+                t(vp + "pre_tile_positional_embedding.gate")
+            ).reshape(1),
+        },
+        "post_tile_positional_embedding": {
+            "embedding": jnp.asarray(
+                t(vp + "post_tile_positional_embedding.embedding.weight")
+            ),
+            "gate": jnp.asarray(
+                t(vp + "post_tile_positional_embedding.gate")
+            ).reshape(1),
+        },
+        "layernorm_pre": ln(vp + "layernorm_pre"),
+        "layernorm_post": ln(vp + "layernorm_post"),
+        "transformer": [
+            vis_layer(f"{vp}transformer.layers.{i}.")
+            for i in range(c.num_hidden_layers)
+        ],
+        "global_transformer": [
+            vis_layer(f"{vp}global_transformer.layers.{i}.")
+            for i in range(c.num_global_layers)
+        ],
+    }
+
+    tp_ = "model.language_model."
+    tc = config.text
+    layers = []
+    for i in range(tc.num_hidden_layers):
+        pre = f"{tp_}layers.{i}."
+        if i in tc.cross_attention_layers:
+            layers.append(
+                {
+                    "input_layernorm": rms(pre + "input_layernorm"),
+                    "cross_attn": {
+                        "q": lin(pre + "cross_attn.q_proj"),
+                        "k": lin(pre + "cross_attn.k_proj"),
+                        "v": lin(pre + "cross_attn.v_proj"),
+                        "o": lin(pre + "cross_attn.o_proj"),
+                        "q_norm": rms(pre + "cross_attn.q_norm"),
+                        "k_norm": rms(pre + "cross_attn.k_norm"),
+                    },
+                    "cross_attn_attn_gate": jnp.asarray(
+                        t(pre + "cross_attn_attn_gate")
+                    ).reshape(1),
+                    "post_attention_layernorm": rms(pre + "post_attention_layernorm"),
+                    "mlp": _hf_mlp(t, pre),
+                    "cross_attn_mlp_gate": jnp.asarray(
+                        t(pre + "cross_attn_mlp_gate")
+                    ).reshape(1),
+                }
+            )
+        else:
+            layers.append(
+                {
+                    "attn_norm": rms(pre + "input_layernorm"),
+                    "attn": {
+                        "qkv": {
+                            "q_kernel": jnp.asarray(t(pre + "self_attn.q_proj.weight").T),
+                            "k_kernel": jnp.asarray(t(pre + "self_attn.k_proj.weight").T),
+                            "v_kernel": jnp.asarray(t(pre + "self_attn.v_proj.weight").T),
+                        },
+                        "o": lin(pre + "self_attn.o_proj"),
+                    },
+                    "mlp_norm": rms(pre + "post_attention_layernorm"),
+                    "mlp": _hf_mlp(t, pre),
+                }
+            )
+
+    return {
+        "vision_model": vision,
+        "multi_modal_projector": lin_b("model.multi_modal_projector"),
+        "embed": {"embedding": jnp.asarray(t(tp_ + "embed_tokens.weight"))},
+        "layers": layers,
+        "final_norm": rms(tp_ + "norm"),
+        "lm_head": lin("lm_head"),
+    }
+
+
+def _hf_mlp(t, pre):
+    import numpy as np
+
+    gate = t(pre + "mlp.gate_proj.weight").T
+    up = t(pre + "mlp.up_proj.weight").T
+    return {
+        "gate_up": jnp.asarray(np.stack([gate, up], axis=1)),  # (H, 2, I)
+        "down": {"kernel": jnp.asarray(t(pre + "mlp.down_proj.weight").T)},
+    }
