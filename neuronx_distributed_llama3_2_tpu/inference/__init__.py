@@ -36,6 +36,13 @@ from neuronx_distributed_llama3_2_tpu.inference.speculative import (
     SpeculativeDecoder,
     SpeculativeResult,
 )
+from neuronx_distributed_llama3_2_tpu.inference.medusa import (
+    MedusaBuffers,
+    MedusaDecoder,
+    MedusaHeads,
+    MedusaResult,
+    generate_medusa_buffers,
+)
 
 __all__ = [
     "ContinuousBatchingEngine",
@@ -46,12 +53,17 @@ __all__ = [
     "KVCache",
     "LatencyCollector",
     "LlamaDecode",
+    "MedusaBuffers",
+    "MedusaDecoder",
+    "MedusaHeads",
+    "MedusaResult",
     "SamplingConfig",
     "SpeculativeDecoder",
     "SpeculativeResult",
     "benchmark_generation",
     "check_accuracy_logits",
     "default_buckets",
+    "generate_medusa_buffers",
     "pick_bucket",
     "sample",
 ]

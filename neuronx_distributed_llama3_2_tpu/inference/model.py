@@ -127,6 +127,7 @@ class LlamaDecode:
         *,
         context_encode: bool = False,
         return_hidden: bool = False,
+        tree: Optional[Tuple[jax.Array, jax.Array]] = None,
     ) -> Tuple[jax.Array, KVCache]:
         """Block-causal forward over the cache.
 
@@ -135,14 +136,30 @@ class LlamaDecode:
         block (bucket-causal, no cache read) — the fast prefill path; the
         general path attends over the whole cache with the mask
         ``j <= position + t``.
+
+        ``tree``: Medusa-style tree verification — a pair
+        ``(depths (T,) int32, ancestor_mask (T, T) bool)``. The fresh block
+        is a candidate *tree*, not a sequence: token i sits at sequence
+        depth ``position + depths[i]`` (rope + causal base) but is written
+        at cache row ``position + i``; within the block, query i attends
+        key j iff ``ancestor_mask[i, j]`` (its ancestors on the tree path),
+        plus the whole committed prefix.
         """
         c = self.config
         model = self._model()
         b, t = tokens.shape
+        if context_encode and tree is not None:
+            raise ValueError(
+                "tree verification runs through the cache-attention path; "
+                "context_encode=True would silently ignore the ancestor mask"
+            )
         if slots is None:
             slots = jnp.arange(b, dtype=jnp.int32)
 
-        pos_block = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        if tree is None:
+            pos_block = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        else:
+            pos_block = positions[:, None] + tree[0][None, :]
         sin, cos = precompute_rope(
             c.head_dim, cache.max_len, c.rope_theta, c.rope_scaling
         )
@@ -155,7 +172,7 @@ class LlamaDecode:
             lp, kc, vc = layer_in
             x, kc, vc = self._decode_layer(
                 lp, x, kc, vc, sin, cos, pos_block, positions, slots,
-                context_encode=context_encode,
+                context_encode=context_encode, tree=tree,
             )
             return x, (kc, vc)
 
@@ -181,7 +198,7 @@ class LlamaDecode:
 
     def _decode_layer(
         self, lp, x, kc, vc, sin, cos, pos_block, positions, slots,
-        *, context_encode: bool,
+        *, context_encode: bool, tree=None,
     ):
         """One decoder layer with cache read/write.
 
@@ -209,9 +226,15 @@ class LlamaDecode:
         # scatter-write the fresh block into the cache at (slot, position) —
         # the reference's position_ids/seq_ids KV scatter (model_base.py:389-419);
         # writes cast to the cache dtype so cache_dtype survives and donation
-        # can reuse the buffers
-        kc = kc.at[slots[:, None], pos_block].set(k.astype(kc.dtype))
-        vc = vc.at[slots[:, None], pos_block].set(v.astype(vc.dtype))
+        # can reuse the buffers. Tree blocks write at consecutive rows
+        # (position + i), decoupled from their rope depth in pos_block.
+        write_rows = (
+            pos_block
+            if tree is None
+            else positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        )
+        kc = kc.at[slots[:, None], write_rows].set(k.astype(kc.dtype))
+        vc = vc.at[slots[:, None], write_rows].set(v.astype(vc.dtype))
 
         ha = _head_axis(c.num_heads)
         if context_encode:
@@ -228,7 +251,9 @@ class LlamaDecode:
             # attend over the cache rows of the active slots
             k_all = jnp.take(kc, slots, axis=0).astype(q.dtype)  # (b,S_max,NKV,D)
             v_all = jnp.take(vc, slots, axis=0).astype(q.dtype)
-            att = self._cache_attention(q, k_all, v_all, pos_block, ha)
+            att = self._cache_attention(
+                q, k_all, v_all, pos_block, ha, positions=positions, tree=tree
+            )
 
         att = att.reshape(b, t, c.num_heads * c.head_dim)
         x = x + attn._o()(lp["attn"]["o"], att)
@@ -236,7 +261,7 @@ class LlamaDecode:
         x = x + LlamaMLP(c)(lp["mlp"], h)
         return x, kc, vc
 
-    def _cache_attention(self, q, k_all, v_all, pos_block, ha):
+    def _cache_attention(self, q, k_all, v_all, pos_block, ha, positions=None, tree=None):
         """q (b,T,N,D) against full cache rows (b,S_max,NKV,D) with the mask
         ``cache_index <= position + t`` (block-causal across the fresh block,
         full visibility of the committed prefix; garbage rows beyond the
@@ -253,7 +278,20 @@ class LlamaDecode:
         scores = constrain(scores, P(BATCH_AXES, ha, None, None))
         scores = scores.astype(jnp.float32)
         j = jax.lax.iota(jnp.int32, s_max)[None, None, :]  # (1,1,S_max)
-        mask = j <= pos_block[:, :, None]  # (b,T,S_max)
+        if tree is None:
+            mask = j <= pos_block[:, :, None]  # (b,T,S_max)
+        else:
+            # committed prefix: rows < position; in-block: the candidate
+            # tree's ancestor mask over rows [position, position + T)
+            u = j - positions[:, None, None]  # (b,1,S_max) offset into block
+            prefix_ok = j < positions[:, None, None]
+            in_block = (u >= 0) & (u < t)
+            anc = tree[1][None, :, :]  # (1,T,T) [query, key-offset]
+            u_cl = jnp.clip(u, 0, t - 1)
+            tree_ok = jnp.take_along_axis(
+                jnp.broadcast_to(anc, (q.shape[0], t, t)), u_cl, axis=2
+            )
+            mask = prefix_ok | (in_block & tree_ok)
         scores = jnp.where(mask[:, None, :, :], scores, jnp.float32(-1e30))
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bnst,btnd->bsnd", probs, v_all)

@@ -1,0 +1,181 @@
+"""Medusa decoding tests (reference utils/medusa_utils.py roles): static
+tree buffers, tree-attention verification, and the hard invariant — Medusa
+greedy output == plain greedy decoding of the same model."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.inference.engine import (
+    GenerationConfig,
+    InferenceEngine,
+)
+from neuronx_distributed_llama3_2_tpu.inference.medusa import (
+    MedusaBuffers,
+    MedusaDecoder,
+    MedusaHeads,
+    generate_medusa_buffers,
+)
+from neuronx_distributed_llama3_2_tpu.models.llama import LLAMA_CONFIGS, LlamaForCausalLM
+
+TINY = LLAMA_CONFIGS["tiny"]
+
+
+# ---------------------------------------------------------------------------
+# buffers (reference generate_medusa_buffers :32)
+# ---------------------------------------------------------------------------
+
+def test_buffers_structure():
+    b = generate_medusa_buffers([(0,), (1,), (0, 0)], topk=4)
+    # slots: root + 3 prefixes
+    assert b.tree_len == 4
+    assert b.depths.tolist() == [0, 1, 1, 2]
+    # tree_indices: root=0(base), (0,)→1+0*4+0=1, (1,)→2, (0,0)→head1 rank0 = 1+4
+    assert b.tree_indices.tolist() == [0, 1, 2, 5]
+    # ancestors: (0,0) slot (3) descends from (0,) slot (1) and root
+    assert b.ancestor_mask[3].tolist() == [True, True, False, True]
+    # paths root→leaf
+    assert b.retrieve_indices.tolist() == [[0, 1, -1], [0, 2, -1], [0, 1, 3]]
+
+
+def test_buffers_reject_rank_beyond_topk():
+    with pytest.raises(ValueError):
+        generate_medusa_buffers([(5,)], topk=4)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end greedy equivalence
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = dataclasses.replace(TINY, loss_chunk_size=None)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0))
+    return InferenceEngine(cfg, params, max_batch=2, max_seq_len=128)
+
+
+def test_medusa_matches_plain_greedy(engine):
+    """The whole point: medusa tree decode must emit exactly the plain
+    greedy continuation (acceptance is greedy-filtered)."""
+    prompt = list(np.random.default_rng(0).integers(0, TINY.vocab_size, 9))
+    ref = engine.generate(
+        [prompt], GenerationConfig(max_new_tokens=24)
+    ).sequences[0]
+
+    heads = MedusaHeads(TINY.hidden_size, TINY.vocab_size, num_heads=3)
+    mp = heads.init(jax.random.key(7))
+    dec = MedusaDecoder(engine, mp, num_heads=3)
+    out = dec.generate(prompt, max_new_tokens=24)
+    assert out.tokens == list(ref), (out.tokens, list(ref))
+    assert len(out.accepted_per_round) >= 1
+
+
+def test_medusa_oracle_candidates_accept_and_stay_greedy(engine):
+    """Force the multi-token acceptance path: inject the TRUE greedy
+    continuation as the chain-path candidates. Rounds must accept > 0
+    tokens AND the final output must still equal plain greedy — this is the
+    test that catches frontier/cache off-by-ones that zero-acceptance runs
+    hide (review finding)."""
+    prompt = list(np.random.default_rng(1).integers(0, TINY.vocab_size, 5))
+    ref = list(
+        engine.generate([prompt], GenerationConfig(max_new_tokens=16)).sequences[0]
+    )
+
+    class OracleDecoder(MedusaDecoder):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.ref = ref
+
+        def _candidates(self, base_token, medusa_logits):
+            flat = super()._candidates(base_token, medusa_logits)
+            # overwrite the chain path (slots sorted by depth on path 0,0,0)
+            # with the true continuation of base_token
+            try:
+                i = self.ref.index(base_token)
+            except ValueError:
+                return flat
+            chain = self.ref[i + 1 : i + 1 + int(self.buffers.depths.max())]
+            # chain slots: the prefix path (0,), (0,0), (0,0,0) = slots where
+            # tree_indices == 1 + head*topk + 0
+            for d, tok in enumerate(chain, start=1):
+                slot = [
+                    s for s in range(self.buffers.tree_len)
+                    if self.buffers.depths[s] == d
+                    and self.buffers.tree_indices[s] == 1 + (d - 1) * self.buffers.topk
+                ]
+                if slot:
+                    flat[slot[0]] = tok
+            return flat
+
+    heads = MedusaHeads(TINY.hidden_size, TINY.vocab_size, num_heads=3)
+    mp = heads.init(jax.random.key(3))
+    dec = OracleDecoder(engine, mp, num_heads=3)
+    out = dec.generate(prompt, max_new_tokens=16)
+    assert out.tokens == ref, (out.tokens, ref)
+    # the oracle chain must actually get accepted at least once
+    assert max(out.accepted_per_round) > 0, out.accepted_per_round
+
+
+def test_medusa_cache_rows_match_plain_decode(engine):
+    """After medusa generation, committed KV rows equal plain decode's for
+    the same emitted sequence (direct detector for the commit off-by-one)."""
+    import copy
+
+    prompt = list(np.random.default_rng(4).integers(0, TINY.vocab_size, 6))
+    heads = MedusaHeads(TINY.hidden_size, TINY.vocab_size, num_heads=3)
+    mp = heads.init(jax.random.key(9))
+    dec = MedusaDecoder(engine, mp, num_heads=3)
+    out = dec.generate(prompt, max_new_tokens=10)
+    med_cache_k = np.asarray(dec.engine.cache.k)
+
+    # replay: prefill + sequential single-token decode of the same tokens
+    full = prompt + out.tokens
+    base, _ = dec._prefill(prompt)
+    pos = len(prompt)
+    for tok_pos in range(len(prompt), len(full) - 1):
+        _, _, dec.engine.cache = dec._commit(
+            dec.engine.params, dec.engine.cache,
+            jnp.asarray([[full[tok_pos]]], jnp.int32),
+            jnp.asarray([tok_pos], jnp.int32),
+        )
+    seq_cache_k = np.asarray(dec.engine.cache.k)
+    # committed rows [0, len(full)-1) must agree
+    n = len(full) - 1
+    np.testing.assert_allclose(
+        med_cache_k[:, 0, :n], seq_cache_k[:, 0, :n], atol=2e-5,
+        err_msg="medusa-committed KV rows diverge from sequential decode",
+    )
+
+
+def test_tree_attention_matches_sequential(engine):
+    """Verification forward with a chain tree (each node child of the
+    previous) must equal the plain sequential block-causal forward."""
+    eng = engine
+    prompt = list(np.random.default_rng(2).integers(0, TINY.vocab_size, 7))
+    heads = MedusaHeads(TINY.hidden_size, TINY.vocab_size, num_heads=3)
+    dec = MedusaDecoder(
+        eng, heads.init(jax.random.key(5)),
+        buffers=generate_medusa_buffers([(0,), (0, 0), (0, 0, 0)], topk=2),
+    )
+    base, _ = dec._prefill(prompt)
+    chain = np.asarray(
+        [base, 11, 12, 13], np.int32
+    )  # root + arbitrary linear chain
+    depths = jnp.asarray(dec.buffers.depths)
+    anc = jnp.asarray(dec.buffers.ancestor_mask)
+    pos = jnp.asarray([len(prompt)], jnp.int32)
+
+    logits_tree, _, _ = dec._fwd_hidden(
+        eng.params, eng.cache, jnp.asarray(chain[None]), pos,
+        tree=(depths, anc),
+    )
+    logits_seq, _, _ = dec._fwd_hidden(
+        eng.params, eng.cache, jnp.asarray(chain[None]), pos
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_tree), np.asarray(logits_seq), atol=2e-5, rtol=1e-5
+    )
