@@ -197,6 +197,7 @@ class MedusaDecoder:
         self._verify = None
         self._commit = None
         self._prefill_fn = None
+        self._heads_fn = None
 
     # -- jitted programs ---------------------------------------------------
 
@@ -206,16 +207,18 @@ class MedusaDecoder:
         toks = np.zeros((1, bucket), np.int32)
         toks[0, : len(prompt)] = prompt
         if self._prefill_fn is None:
-            self._prefill_fn = jax.jit(
-                lambda p, cache, t: self._fwd_hidden(
+            def f(p, cache, t):
+                logits, hidden, cache = self._fwd_hidden(
                     p, cache, t, jnp.zeros((1,), jnp.int32), context_encode=True
                 )
-            )
-        logits, hidden, eng.cache = self._prefill_fn(
+                return jnp.argmax(logits, axis=-1), hidden, cache
+
+            self._prefill_fn = jax.jit(f)
+        greedy, hidden, eng.cache = self._prefill_fn(
             eng.params, eng.cache, jnp.asarray(toks)
         )
         last = len(prompt) - 1
-        return int(jnp.argmax(logits[0, last])), hidden[:, last]
+        return int(greedy[0, last]), hidden, last
 
     def _fwd_hidden(self, p, cache, toks, pos, *, context_encode=False, tree=None):
         hidden, cache = self.engine.model.forward(
@@ -227,12 +230,25 @@ class MedusaDecoder:
 
     # -- one round ---------------------------------------------------------
 
-    def _candidates(self, base_token: int, medusa_logits) -> np.ndarray:
+    def _heads_topk(self, hidden, slot):
+        """Jitted medusa-head top-k at one hidden slot: (Kh, topk) ids.
+        Head matmuls + top_k run inside ONE program (review finding: the
+        eager per-op dispatch of K LM-head-sized matmuls per round)."""
+        if self._heads_fn is None:
+            topk = self.buffers.topk
+
+            def f(mp, hidden, slot):
+                med = self.heads(mp, hidden[:, slot])[:, 0]  # (Kh, V)
+                return jax.lax.top_k(med, topk)[1]
+
+            self._heads_fn = jax.jit(f)
+        return self._heads_fn(self.medusa_params, hidden, slot)
+
+    def _candidates(self, base_token: int, topk_ids) -> np.ndarray:
         """Flat candidate pool [base, head0 topk..., head1 topk...] → tree
         slots (reference generate_candidates :120)."""
         bufs = self.buffers
-        tk = jax.lax.top_k(medusa_logits, bufs.topk)[1]  # (K, topk)
-        flat = np.concatenate([[base_token], np.asarray(tk).reshape(-1)])
+        flat = np.concatenate([[base_token], np.asarray(topk_ids).reshape(-1)])
         return flat[bufs.tree_indices].astype(np.int32)
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64) -> MedusaResult:
@@ -256,22 +272,33 @@ class MedusaDecoder:
         bufs = self.buffers
         L = bufs.tree_len
         K = int(bufs.depths.max())  # max acceptable tokens per round
-        base, hidden_last = self._prefill(prompt)
-        med_logits = self.heads(self.medusa_params, hidden_last)[:, 0]  # (Kh, V)
+        base, hidden, last = self._prefill(prompt)
+        topk_ids = self._heads_topk(hidden, last)  # (Kh, topk)
         out: List[int] = [base]
         accepted_hist: List[int] = []
         pos = len(prompt)  # committed rows; out[-1] is the uncommitted root
+
+        # capacity: every round's verify needs L rows past the frontier;
+        # refuse over-capacity requests upfront rather than silently
+        # truncating (same contract as SpeculativeDecoder, speculative.py:72)
+        if len(prompt) + max_new_tokens - 1 + L > eng.cache.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} + "
+                f"tree {L} exceeds cache capacity {eng.cache.max_len}"
+            )
 
         depths = jnp.asarray(bufs.depths)
         anc = jnp.asarray(bufs.ancestor_mask)
         retrieve = np.asarray(bufs.retrieve_indices)
 
         if self._verify is None:
-            self._verify = jax.jit(
-                lambda p, cache, t, pos, d=depths, a=anc: self._fwd_hidden(
+            def vf(p, cache, t, pos, d=depths, a=anc):
+                logits, hidden, cache = self._fwd_hidden(
                     p, cache, t, pos, tree=(d, a)
                 )
-            )
+                return jnp.argmax(logits, axis=-1), hidden, cache
+
+            self._verify = jax.jit(vf)
             self._commit = jax.jit(self._fwd_hidden)
         verify, commit = self._verify, self._commit
 
@@ -281,12 +308,12 @@ class MedusaDecoder:
             # error; same guard as speculative.py:72-85)
             if pos + L > eng.cache.max_len:
                 break
-            tree_tokens = self._candidates(out[-1], med_logits)
-            logits, hidden, eng.cache = verify(
+            tree_tokens = self._candidates(out[-1], topk_ids)
+            greedy_dev, hidden, eng.cache = verify(
                 eng.params, eng.cache, jnp.asarray(tree_tokens[None, :]),
                 jnp.asarray([pos], jnp.int32),
             )
-            greedy = np.asarray(jnp.argmax(logits[0], axis=-1))  # (L,)
+            greedy = np.asarray(greedy_dev[0])  # (L,)
 
             # greedy acceptance over root→leaf paths (evaluate_posterior
             # :151): candidate at depth d survives iff it equals the model's
@@ -323,8 +350,6 @@ class MedusaDecoder:
                 )
             out.extend(accepted + [bonus])
             pos += 1 + best_len  # root + accepted committed; bonus = new root
-            med_logits = self.heads(
-                self.medusa_params, hidden[:, last_slot]
-            )[:, 0]
+            topk_ids = self._heads_topk(hidden, last_slot)
 
         return MedusaResult(tokens=out[:max_new_tokens], accepted_per_round=accepted_hist)

@@ -11,10 +11,10 @@ per-layer router logits feed the Switch load-balancing loss
 
 Implements the same model protocol as :class:`.llama.LlamaForCausalLM`
 (init/specs/__call__/loss/loss_from_hidden), so the trainer and checkpoint
-layers work unchanged. The pipeline executor does NOT support MoE yet
-(:class:`..pipeline.PipelinedCausalLM` scans a plain hidden-state carry and
-its loss path would drop the router aux loss); it rejects MoE models
-explicitly.
+layers work unchanged. The GPipe pipeline executor supports MoE: its stage
+scan carries a router-aux stream alongside the hidden state
+(:class:`..pipeline.PipelinedCausalLM`, validity-masked over fill/drain
+rotations); the 1F1B executor remains dense-only.
 """
 
 from __future__ import annotations

@@ -143,6 +143,12 @@ class MoE:
         c = self.config
         experts = self._experts()
         mesh = parallel_state.get_parallel_state().mesh
+        # inside a partial-manual region (the pp pipeline stage) the nested
+        # shard_map must target the ambient abstract mesh (its manual axes
+        # are marked) — same rule as layers.constrain / parallel CE
+        ambient = jax.sharding.get_abstract_mesh()
+        if ambient is not None and not ambient.empty:
+            mesh = ambient
         t = x_flat.shape[0]
         dp_ep = mesh.shape[DP_AXIS] * mesh.shape[EP_AXIS]
         if t % dp_ep != 0:

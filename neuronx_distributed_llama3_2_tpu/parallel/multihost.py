@@ -1,0 +1,109 @@
+"""Multi-host bootstrap & coordination.
+
+TPU-native replacement for the reference's process bootstrap layer: the
+``torch.distributed`` xla-backend init + TCPStore side-channels
+(``parallel_state.py:13-19,667-682``, ``pipeline/comm.py:112-197``) and the
+``NXD_SKIP_RENDEZVOUS`` checkpoint rendezvous (checkpointing.py:23).
+
+On TPU pods the runtime provides most of this: ``jax.distributed`` starts
+the coordination service (one controller per host, auto-discovering the
+coordinator on Cloud TPU), after which ``jax.devices()`` spans every host
+and the one-mesh GSPMD design works unchanged — DCN-spanning mesh axes
+should be the *outermost* ones (pp/dp) so their collectives cross DCN while
+tp/cp stay on ICI (the axis order build_mesh already pins).
+
+What remains and lives here:
+
+- :func:`initialize_distributed` — idempotent ``jax.distributed.initialize``
+  wrapper with env-based opt-out, the analogue of the reference's
+  ``torch.distributed.init_process_group`` call sites.
+- :func:`sync_global_devices` — named barrier (the reference's rendezvous,
+  checkpointing.py:23) used around checkpoint commit points.
+- :func:`broadcast_from_host0` — small-pytree broadcast, the role of the
+  reference's gloo python-object side-channel (comm.py:112-127) for config
+  agreement; on JAX it rides a device all-reduce.
+- :func:`is_coordinator` — "rank 0" gating for logging/checkpoint writes
+  (the checkpoint layer already gates on ``jax.process_index() == 0``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Start (or join) the JAX coordination service. Safe to call on a
+    single host (no-op) and safe to call twice (idempotent).
+
+    With no arguments on Cloud TPU, ``jax.distributed.initialize``
+    auto-discovers everything from the TPU metadata. Off-TPU (CI, CPU
+    fleets), pass the coordinator explicitly or set the standard
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``
+    environment variables. ``NXDT_SKIP_DISTRIBUTED_INIT=1`` opts out
+    (the reference's NXD_SKIP_RENDEZVOUS escape hatch)."""
+    global _INITIALIZED
+    if _INITIALIZED or os.environ.get("NXDT_SKIP_DISTRIBUTED_INIT") == "1":
+        return
+    if (
+        coordinator_address is None
+        and num_processes is None
+        and "JAX_COORDINATOR_ADDRESS" not in os.environ
+        and len(os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")) <= 1
+    ):
+        # single-process (tests, laptops, 1-host TPU): nothing to initialize
+        _INITIALIZED = True
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        logger.info(
+            "distributed initialized: process %d/%d",
+            jax.process_index(),
+            jax.process_count(),
+        )
+    except RuntimeError as e:  # already initialized by the launcher
+        logger.info("distributed init skipped: %s", e)
+    _INITIALIZED = True
+
+
+def is_coordinator() -> bool:
+    """True on the process that writes checkpoints/logs (reference rank-0
+    gating, utils/logger.py:16-51)."""
+    return jax.process_index() == 0
+
+
+def sync_global_devices(name: str) -> None:
+    """Barrier across all hosts (reference checkpoint rendezvous,
+    checkpointing.py:23). No-op single-process."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_host0(tree: Any) -> Any:
+    """Broadcast a small host pytree from process 0 to all processes (the
+    reference's python-object side channel, comm.py:112-127). No-op
+    single-process."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(tree)

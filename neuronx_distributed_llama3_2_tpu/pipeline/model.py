@@ -75,18 +75,28 @@ class PipelinedCausalLM:
     schedule: str = "gpipe"
 
     def __post_init__(self):
-        # The stage scan carries a plain hidden-state; MoE decoder layers
-        # return (x, aux) and their router aux loss would be dropped by the
-        # pipelined loss path. Reject rather than miscompute.
-        if not isinstance(self.model, LlamaForCausalLM):
+        if not (isinstance(self.model, LlamaForCausalLM) or self._is_moe()):
             raise TypeError(
-                f"PipelinedCausalLM supports LlamaForCausalLM only, got "
-                f"{type(self.model).__name__} (MoE models are not pipelined yet)"
+                f"PipelinedCausalLM supports LlamaForCausalLM / "
+                f"MixtralForCausalLM, got {type(self.model).__name__}"
             )
         if self.schedule not in SCHEDULES:
             raise ValueError(
                 f"schedule must be one of {SCHEDULES}, got {self.schedule!r}"
             )
+        if self._is_moe() and self.schedule == "1f1b":
+            raise ValueError(
+                "MoE pipelining runs under schedule='gpipe' (the 1f1b "
+                "manual-VJP executor carries a plain hidden stream; the "
+                "router aux stream is gpipe-only today)"
+            )
+
+    def _is_moe(self) -> bool:
+        from neuronx_distributed_llama3_2_tpu.models.mixtral import (
+            MixtralForCausalLM,
+        )
+
+        return isinstance(self.model, MixtralForCausalLM)
 
     @property
     def config(self):
@@ -148,17 +158,23 @@ class PipelinedCausalLM:
         mesh = parallel_state.get_parallel_state().mesh
         policy = _remat_policy(cfg.remat)
 
+        moe = self._is_moe()
+
         def body(stage_layers_l, stream_l, sin, cos, positions):
             x = stream_l[0]  # (mbs, S, H) — this stage's microbatch
             lp = jax.tree.map(lambda p: p[0], stage_layers_l)
 
             def layer_body(x, one_layer):
-                return layer(one_layer, x, sin, cos, positions), None
+                out = layer(one_layer, x, sin, cos, positions)
+                if moe:
+                    x, aux = out  # MoE layers return (x, router aux loss)
+                    return x, aux
+                return out, jnp.float32(0.0)
 
             if policy is not None:
                 layer_body = jax.checkpoint(layer_body, policy=policy)
-            x, _ = lax.scan(layer_body, x, lp)
-            return x[None]
+            x, auxes = lax.scan(layer_body, x, lp)
+            return x[None], jnp.mean(auxes)[None]
 
         layer_specs = jax.tree.map(
             lambda _: P(PP_AXIS),
@@ -168,7 +184,7 @@ class PipelinedCausalLM:
             body,
             mesh=mesh,
             in_specs=(layer_specs, P(PP_AXIS), P(), P(), P()),
-            out_specs=P(PP_AXIS),
+            out_specs=(P(PP_AXIS), P(PP_AXIS)),
             axis_names={PP_AXIS},
             check_vma=False,
         )(stage_layers, stream, sin, cos, positions)
@@ -197,7 +213,7 @@ class PipelinedCausalLM:
         out_buf = jnp.zeros((M, mbs, S, x.shape[-1]), cfg.dtype)
 
         def rotate(carry, t):
-            stream, out_buf = carry
+            stream, out_buf, aux_sum = carry
             # inject the next microbatch into stage 0; the clamped reads past
             # M feed garbage whose outputs never reach out_buf (they would
             # arrive after the last rotation)
@@ -211,8 +227,16 @@ class PipelinedCausalLM:
                 stream, inject.astype(cfg.dtype), 0, axis=0
             )
             stream = constrain(stream, P(PP_AXIS, BATCH_AXES, None, None))
-            stream = self._stage_apply(
+            stream, stage_aux = self._stage_apply(
                 params["layers"], stream, sin, cos, positions
+            )
+            # router aux (MoE): lane s is processing a real microbatch at
+            # rotation t iff 0 <= t - s < M; fill/drain lanes run on garbage
+            # and must not contaminate the aux mean
+            lane = jnp.arange(pp)
+            valid = ((t - lane) >= 0) & ((t - lane) < M)
+            aux_sum = aux_sum + jnp.sum(
+                jnp.where(valid, stage_aux.astype(jnp.float32), 0.0)
             )
             out = lax.index_in_dim(stream, pp - 1, axis=0, keepdims=False)
             # writes for t < pp-1 land on index 0 and are overwritten by the
@@ -220,24 +244,32 @@ class PipelinedCausalLM:
             out_buf = lax.dynamic_update_index_in_dim(
                 out_buf, out, jnp.clip(t - (pp - 1), 0, M - 1), axis=0
             )
-            return (stream, out_buf), None
+            return (stream, out_buf, aux_sum), None
 
-        (stream, out_buf), _ = lax.scan(
-            rotate, (stream, out_buf), jnp.arange(M + pp - 1)
+        (stream, out_buf, aux_sum), _ = lax.scan(
+            rotate, (stream, out_buf, jnp.float32(0.0)), jnp.arange(M + pp - 1)
         )
         # undo the strided microbatch split
         hidden = out_buf.swapaxes(0, 1).reshape(gbs, S, -1)
-        return self.model._norm()(params["final_norm"], hidden)
+        hidden = self.model._norm()(params["final_norm"], hidden)
+        # every (stage, microbatch) pair contributed its stage-mean aux once
+        return hidden, aux_sum / (pp * M)
 
     def __call__(self, params: Params, input_ids: jax.Array) -> jax.Array:
-        hidden = self._pipeline_hidden(params, input_ids)
+        hidden, _ = self._pipeline_hidden(params, input_ids)
         return self.model._logits(params, hidden)
 
     def loss(
         self, params: Params, input_ids: jax.Array, labels: jax.Array
     ) -> jax.Array:
-        hidden = self._pipeline_hidden(params, input_ids)
-        return self.model.loss_from_hidden(params, hidden, labels)
+        hidden, aux = self._pipeline_hidden(params, input_ids)
+        ce = self.model.loss_from_hidden(params, hidden, labels)
+        if self._is_moe():
+            # per-(layer, microbatch) aux mean — the microbatched analogue of
+            # the unpipelined per-layer full-batch mean (identical at M=1;
+            # the trainer's grad-accumulation path averages the same way)
+            return ce + self.config.router_aux_loss_coef * aux
+        return ce
 
     # -- 1F1B: fused forward+backward with O(pp) activation memory ----------
 

@@ -134,7 +134,7 @@ def test_medusa_cache_rows_match_plain_decode(engine):
 
     # replay: prefill + sequential single-token decode of the same tokens
     full = prompt + out.tokens
-    base, _ = dec._prefill(prompt)
+    base, _, _ = dec._prefill(prompt)
     pos = len(prompt)
     for tok_pos in range(len(prompt), len(full) - 1):
         _, _, dec.engine.cache = dec._commit(
@@ -161,7 +161,7 @@ def test_tree_attention_matches_sequential(engine):
         eng, heads.init(jax.random.key(5)),
         buffers=generate_medusa_buffers([(0,), (0, 0), (0, 0, 0)], topk=2),
     )
-    base, _ = dec._prefill(prompt)
+    base, _, _ = dec._prefill(prompt)
     chain = np.asarray(
         [base, 11, 12, 13], np.int32
     )  # root + arbitrary linear chain

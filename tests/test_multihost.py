@@ -1,0 +1,34 @@
+"""Multi-host bootstrap tests (single-process semantics; the multi-process
+paths are thin delegations to jax.distributed/multihost_utils)."""
+
+import os
+
+import jax
+
+from neuronx_distributed_llama3_2_tpu.parallel import multihost
+
+
+def test_initialize_noop_single_process(monkeypatch):
+    monkeypatch.setattr(multihost, "_INITIALIZED", False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    multihost.initialize_distributed()  # must not raise on CPU tests
+    assert multihost._INITIALIZED
+
+
+def test_initialize_idempotent(monkeypatch):
+    monkeypatch.setattr(multihost, "_INITIALIZED", False)
+    multihost.initialize_distributed()
+    multihost.initialize_distributed()  # second call is a no-op
+
+
+def test_skip_env(monkeypatch):
+    monkeypatch.setattr(multihost, "_INITIALIZED", False)
+    monkeypatch.setenv("NXDT_SKIP_DISTRIBUTED_INIT", "1")
+    multihost.initialize_distributed("definitely-not-a-host:1234", 2, 0)
+    assert not multihost._INITIALIZED  # skipped without touching jax
+
+
+def test_coordinator_and_barrier_single_process():
+    assert multihost.is_coordinator()
+    multihost.sync_global_devices("test")  # no-op, no hang
+    assert multihost.broadcast_from_host0({"a": 1}) == {"a": 1}
