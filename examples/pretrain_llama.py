@@ -56,6 +56,10 @@ def parse_args():
     p.add_argument("--keep-ckpts", type=int, default=3)
     p.add_argument("--metrics-file", default=None)
     p.add_argument(
+        "--native-loader", action="store_true",
+        help="use the C++ mmap+prefetch token loader (native/token_loader.cc)",
+    )
+    p.add_argument(
         "--timeline", default=None,
         help="write a Chrome-trace host timeline (events: step/data/ckpt)",
     )
@@ -161,8 +165,22 @@ def main():
             )
     if not data_path:
         raise SystemExit("pass --data FILE.npy or --synthetic N")
+    dataset = None
+    if args.native_loader:
+        from neuronx_distributed_llama3_2_tpu.data.native_loader import (
+            NativeTokenDataset,
+            native_available,
+        )
+
+        if native_available():
+            dataset = NativeTokenDataset(data_path, args.seq_len)
+        else:
+            logger.warning("--native-loader requested but no C++ toolchain; "
+                           "using the numpy loader")
+    if dataset is None:
+        dataset = TokenDataset(data_path, args.seq_len)
     loader = DistributedDataLoader(
-        TokenDataset(data_path, args.seq_len),
+        dataset,
         args.global_batch,
         seed=args.seed,
     )

@@ -1,0 +1,179 @@
+// Native token-stream loader: mmap + background prefetch batch gather.
+//
+// The native counterpart of data/dataset.py's TokenDataset/DistributedDataLoader
+// hot path (the role torch's C++ DataLoader workers play in the reference's
+// pipeline, training_utils.py:99). The Python side stays in charge of
+// *policy* — epoch shuffling, dp sharding, resume — and hands this library
+// explicit sample indices; the library owns the *mechanism*: zero-copy mmap
+// of the token file, int-width conversion, and a worker thread that gathers
+// the next batch while the accelerator runs the current step.
+//
+// C ABI only (loaded via ctypes — no pybind11 dependency, per the build
+// environment).  All functions are thread-compatible: one handle is driven
+// by one Python thread.
+
+#include <cstdint>
+#include <cstring>
+#include <condition_variable>
+#include <fcntl.h>
+#include <mutex>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Loader {
+  int fd = -1;
+  const uint8_t* base = nullptr;   // mmap base
+  size_t map_len = 0;
+  long long data_off = 0;          // byte offset of token 0 (.npy header)
+  long long n_tokens = 0;
+  int token_bytes = 4;             // 1/2/4/8 little-endian
+  bool is_signed = true;
+
+  // prefetch state
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<long long> pending;  // sample indices to gather
+  int pending_seq = 0;
+  std::vector<int32_t> ready;      // gathered batch
+  bool job_posted = false;
+  bool job_active = false;         // worker is mid-gather
+  bool job_done = false;
+  bool stop = false;
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    if (worker.joinable()) worker.join();
+    if (base) munmap(const_cast<uint8_t*>(base), map_len);
+    if (fd >= 0) close(fd);
+  }
+
+  inline int32_t token_at(long long i) const {
+    const uint8_t* p = base + data_off + i * (long long)token_bytes;
+    if (is_signed) {
+      switch (token_bytes) {
+        case 1: return (int32_t) * (const int8_t*)p;
+        case 2: { int16_t v; memcpy(&v, p, 2); return v; }
+        case 8: { int64_t v; memcpy(&v, p, 8); return (int32_t)v; }
+        default: { int32_t v; memcpy(&v, p, 4); return v; }
+      }
+    }
+    // unsigned: widen without sign-extension (uint32/64 wrap to int32 the
+    // way numpy's astype(int32) does — parity with the python path)
+    switch (token_bytes) {
+      case 1: return (int32_t) * (const uint8_t*)p;
+      case 2: { uint16_t v; memcpy(&v, p, 2); return (int32_t)v; }
+      case 8: { uint64_t v; memcpy(&v, p, 8); return (int32_t)v; }
+      default: { uint32_t v; memcpy(&v, p, 4); return (int32_t)v; }
+    }
+  }
+
+  void gather(const long long* idx, int count, int seq, int32_t* out) const {
+    for (int b = 0; b < count; ++b) {
+      const long long start = idx[b] * (long long)seq;
+      if (token_bytes == 4 && is_signed) {
+        memcpy(out + (long long)b * seq,
+               base + data_off + start * 4, (size_t)seq * 4);
+      } else {
+        for (int t = 0; t < seq; ++t)
+          out[(long long)b * seq + t] = token_at(start + t);
+      }
+    }
+  }
+
+  void worker_loop() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (true) {
+      cv.wait(lk, [&] { return stop || job_posted; });
+      if (stop) return;
+      std::vector<long long> idx = std::move(pending);
+      int seq = pending_seq;
+      job_posted = false;
+      job_active = true;
+      ready.resize((size_t)idx.size() * seq);
+      lk.unlock();
+      gather(idx.data(), (int)idx.size(), seq, ready.data());
+      lk.lock();
+      job_active = false;
+      job_done = true;
+      cv.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Open a raw little-endian token file region. Returns nullptr on failure.
+void* tl_open(const char* path, long long data_off, long long n_tokens,
+              int token_bytes, int is_signed) {
+  if (token_bytes != 1 && token_bytes != 2 && token_bytes != 4 &&
+      token_bytes != 8)
+    return nullptr;
+  auto* L = new Loader();
+  L->is_signed = is_signed != 0;
+  L->fd = open(path, O_RDONLY);
+  if (L->fd < 0) { delete L; return nullptr; }
+  struct stat st;
+  if (fstat(L->fd, &st) != 0) { delete L; return nullptr; }
+  const long long need = data_off + n_tokens * (long long)token_bytes;
+  if (st.st_size < need) { delete L; return nullptr; }
+  L->map_len = (size_t)st.st_size;
+  void* m = mmap(nullptr, L->map_len, PROT_READ, MAP_PRIVATE, L->fd, 0);
+  if (m == MAP_FAILED) { delete L; return nullptr; }
+  L->base = (const uint8_t*)m;
+  L->data_off = data_off;
+  L->n_tokens = n_tokens;
+  L->token_bytes = token_bytes;
+  L->worker = std::thread([L] { L->worker_loop(); });
+  return L;
+}
+
+void tl_close(void* h) { delete (Loader*)h; }
+
+long long tl_num_tokens(void* h) { return ((Loader*)h)->n_tokens; }
+
+// Synchronous gather of `count` samples of length `seq` into out (int32).
+void tl_gather(void* h, const long long* idx, int count, int seq,
+               int32_t* out) {
+  ((Loader*)h)->gather(idx, count, seq, out);
+}
+
+// Post the next batch's indices; the worker gathers it in the background.
+void tl_prefetch(void* h, const long long* idx, int count, int seq) {
+  auto* L = (Loader*)h;
+  std::lock_guard<std::mutex> g(L->mu);
+  L->pending.assign(idx, idx + count);
+  L->pending_seq = seq;
+  L->job_posted = true;
+  L->job_done = false;
+  L->cv.notify_all();
+}
+
+// Wait for the posted batch and copy it out. Returns token count, or -1 if
+// nothing was prefetched.
+long long tl_wait(void* h, int32_t* out, long long out_capacity) {
+  auto* L = (Loader*)h;
+  std::unique_lock<std::mutex> lk(L->mu);
+  // a job is outstanding if posted, mid-gather (active), or finished —
+  // inferring only from posted/done races with the worker's take-window
+  if (!L->job_done && !L->job_posted && !L->job_active) return -1;
+  L->cv.wait(lk, [&] { return L->job_done; });
+  const long long n = (long long)L->ready.size();
+  if (n > out_capacity) return -1;
+  memcpy(out, L->ready.data(), (size_t)n * 4);
+  L->job_done = false;
+  return n;
+}
+
+}  // extern "C"

@@ -105,14 +105,19 @@ class DistributedDataLoader:
         self._order_cache = (epoch, order)
         return order
 
-    def batch_at(self, step: int, rows: Optional[slice] = None) -> np.ndarray:
-        """Global batch for ``step``; pass ``rows`` to materialize only a
-        row range (multi-host processes read only their own share)."""
+    def _step_indices(self, step: int, rows: Optional[slice]) -> np.ndarray:
         epoch, within = divmod(step, self.steps_per_epoch)
         order = self._epoch_order(epoch)
         idx = order[within * self.gbs : (within + 1) * self.gbs]
-        if rows is not None:
-            idx = idx[rows]
+        return idx if rows is None else idx[rows]
+
+    def batch_at(self, step: int, rows: Optional[slice] = None) -> np.ndarray:
+        """Global batch for ``step``; pass ``rows`` to materialize only a
+        row range (multi-host processes read only their own share)."""
+        idx = self._step_indices(step, rows)
+        # native path: one C++ gather call instead of a python row loop
+        if hasattr(self.dataset, "gather"):
+            return self.dataset.gather(np.asarray(idx, np.int64))
         return np.stack([self.dataset[int(i)] for i in idx])
 
     def __iter__(self) -> Iterator[np.ndarray]:
@@ -130,9 +135,25 @@ class DistributedDataLoader:
                 )
             per = self.gbs // n_proc
             rows = slice(jax.process_index() * per, (jax.process_index() + 1) * per)
+        prefetching = hasattr(self.dataset, "prefetch")
+        if prefetching:
+            # native double-buffering: the C++ worker gathers step k+1 while
+            # the accelerator runs step k
+            self.dataset.prefetch(
+                np.asarray(self._step_indices(self.state.step, rows), np.int64)
+            )
         while True:
-            batch = self.batch_at(self.state.step, rows=rows)
-            self.state.step += 1
+            if prefetching:
+                batch = self.dataset.wait()
+                self.state.step += 1
+                self.dataset.prefetch(
+                    np.asarray(
+                        self._step_indices(self.state.step, rows), np.int64
+                    )
+                )
+            else:
+                batch = self.batch_at(self.state.step, rows=rows)
+                self.state.step += 1
             yield batch
 
 
