@@ -43,6 +43,10 @@ from neuronx_distributed_llama3_2_tpu.inference.medusa import (
     MedusaResult,
     generate_medusa_buffers,
 )
+from neuronx_distributed_llama3_2_tpu.inference.mllama_decode import (
+    MllamaCache,
+    MllamaDecoder,
+)
 
 __all__ = [
     "ContinuousBatchingEngine",
@@ -57,6 +61,8 @@ __all__ = [
     "MedusaDecoder",
     "MedusaHeads",
     "MedusaResult",
+    "MllamaCache",
+    "MllamaDecoder",
     "SamplingConfig",
     "SpeculativeDecoder",
     "SpeculativeResult",

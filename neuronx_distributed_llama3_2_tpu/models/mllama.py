@@ -573,19 +573,29 @@ class TextCrossAttention:
             "k_norm": self._norm().specs(),
         }
 
-    def __call__(self, params, x, vision_tokens, bias) -> jax.Array:
+    def project_kv(self, params, vision_tokens):
+        """K-normed K and raw V over the vision tokens — computed once per
+        request at decode time (HF caches these the same way,
+        modeling_mllama.py:429-447)."""
         c = self.config
-        b, sq, _ = x.shape
-        skv = vision_tokens.shape[1]
-        q = self._q()(params["q"], x).reshape(b, sq, c.num_heads, c.head_dim)
+        b, skv, _ = vision_tokens.shape
         k = self._kv()(params["k"], vision_tokens).reshape(
             b, skv, c.num_kv_heads, c.head_dim
         )
         v = self._kv()(params["v"], vision_tokens).reshape(
             b, skv, c.num_kv_heads, c.head_dim
         )
+        return self._norm()(params["k_norm"], k), v
+
+    def __call__(self, params, x, vision_tokens, bias, kv=None) -> jax.Array:
+        """``kv``: optional precomputed (k, v) from :meth:`project_kv`
+        (decode path); when absent they are projected from vision_tokens."""
+        c = self.config
+        b, sq, _ = x.shape
+        q = self._q()(params["q"], x).reshape(b, sq, c.num_heads, c.head_dim)
         q = self._norm()(params["q_norm"], q)
-        k = self._norm()(params["k_norm"], k)
+        k, v = kv if kv is not None else self.project_kv(params, vision_tokens)
+        skv = k.shape[1]
         group = c.num_heads // c.num_kv_heads
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
@@ -640,7 +650,7 @@ class CrossAttentionDecoderLayer:
             "cross_attn_mlp_gate": P(None),
         }
 
-    def __call__(self, params, x, vision_tokens, bias, full_row_mask):
+    def __call__(self, params, x, vision_tokens, bias, full_row_mask, kv=None):
         from neuronx_distributed_llama3_2_tpu.models.llama import LlamaMLP
 
         h = TextCrossAttention(self.config)(
@@ -648,6 +658,7 @@ class CrossAttentionDecoderLayer:
             self._norm()(params["input_layernorm"], x),
             vision_tokens,
             bias,
+            kv=kv,
         )
         x = x + jnp.tanh(params["cross_attn_attn_gate"]) * h
         h = LlamaMLP(self._mlp_cfg())(
