@@ -72,6 +72,12 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
     sampling: SamplingConfig = SamplingConfig()
     seed: int = 0
+    # tokens generated per host->device call: the token loop runs as a
+    # lax.scan ON DEVICE in chunks of this size, amortizing the host
+    # round-trip (the role of the reference's fully-traced token-gen NEFF).
+    # 1 = classic per-token loop. EOS is still honored (detected per chunk
+    # on the host; surplus tokens in the final chunk are discarded).
+    on_device_steps: int = 1
 
 
 @dataclasses.dataclass
@@ -157,6 +163,39 @@ class InferenceEngine:
         self._programs[key_] = fn
         return fn
 
+    def _decode_multi_program(self, batch: int, cfg: SamplingConfig, steps: int):
+        """Token-gen program emitting ``steps`` tokens in one executable:
+        lax.scan of (forward T=1 → on-device sample), cache donated through
+        the carry. One host round-trip per ``steps`` tokens."""
+        key_ = ("decode_multi", batch, cfg, steps)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model = self.model
+
+        def decode_n(params, cache, tokens, positions, slots, key):
+            # the key chains exactly like the host loop (one split per
+            # token), so any on_device_steps yields the same sampled
+            # sequence as the per-token path for a given seed
+            def body(carry, _):
+                cache, toks, pos, key = carry
+                key, kd = jax.random.split(key)
+                logits, cache = model.forward(
+                    params, cache, toks[:, None], pos, slots
+                )
+                nxt = sample(logits[:, 0, :], kd, cfg)
+                return (cache, nxt, pos + 1, key), nxt
+
+            (cache, toks, pos, key), outs = jax.lax.scan(
+                body, (cache, tokens, positions, key), None, length=steps
+            )
+            # outs (steps, b); toks/key returned so the caller stays
+            # device-resident and keeps the same rng chain for the tail
+            return outs, toks, key, cache
+
+        fn = jax.jit(decode_n, donate_argnums=(1,))
+        self._programs[key_] = fn
+        return fn
+
     def _verify_program(self, batch: int, block: int):
         """Speculation program: T=block forward returning full block logits
         (reference speculation model, model_base.py:348-352)."""
@@ -184,6 +223,7 @@ class InferenceEngine:
         batch_sizes: Optional[Sequence[int]] = None,
         sampling: SamplingConfig = SamplingConfig(),
         speculative_blocks: Sequence[int] = (),
+        on_device_steps: Sequence[int] = (),
     ) -> float:
         """Eagerly compile every (bucket × batch) program via jit AOT
         (``lower().compile()``) — the ModelBuilder compile() phase
@@ -206,6 +246,11 @@ class InferenceEngine:
             self._programs[("decode", b, sampling)] = fn.lower(
                 params_abs, cache_abs, i32(b), i32(b), i32(b), key_abs
             ).compile()
+            for steps in on_device_steps:
+                fn = self._decode_multi_program(b, sampling, steps)
+                self._programs[("decode_multi", b, sampling, steps)] = fn.lower(
+                    params_abs, cache_abs, i32(b), i32(b), i32(b), key_abs
+                ).compile()
             for block in speculative_blocks:
                 fn = self._verify_program(b, block)
                 self._programs[("verify", b, block)] = fn.lower(
@@ -293,24 +338,49 @@ class InferenceEngine:
         ]
         positions = jnp.asarray(lengths)  # next write position = prompt length
 
-        for _ in range(gen.max_new_tokens - 1):
-            if all(done):
-                break
-            key, kd = jax.random.split(key)
-            with bench.per_token.timed():
-                tokens, _, self.cache = decode(
-                    self.params, self.cache, tokens, positions, slots, kd
+        remaining = gen.max_new_tokens - 1
+        steps = max(1, gen.on_device_steps)
+        decode_multi = (
+            self._decode_multi_program(b, gen.sampling, steps)
+            if steps > 1
+            else None
+        )
+        while remaining > 0 and not all(done):
+            # the multi-step program has a fixed shape: use it for full
+            # chunks; single-step for the tail. (The entry guard already
+            # bounds max_len + max_new_tokens by max_seq_len, so a full
+            # chunk always fits the cache.)
+            if decode_multi is not None and steps <= remaining:
+                t0 = time.perf_counter()
+                toks_block, tokens, key, self.cache = decode_multi(
+                    self.params, self.cache, tokens, positions, slots, key
                 )
-                tokens_host = np.asarray(jax.device_get(tokens))
-            positions = positions + 1
-            for i in range(nreq):
-                if not done[i]:
-                    out[i].append(int(tokens_host[i]))
-                    if (
-                        gen.eos_token_id is not None
-                        and out[i][-1] == gen.eos_token_id
-                    ):
-                        done[i] = True
+                block_host = np.asarray(jax.device_get(toks_block))  # (steps, b)
+                dt = time.perf_counter() - t0
+                for _ in range(steps):
+                    bench.per_token.record(dt / steps)
+                positions = positions + steps
+                emitted = steps
+            else:
+                key, kd = jax.random.split(key)
+                with bench.per_token.timed():
+                    tokens, _, self.cache = decode(
+                        self.params, self.cache, tokens, positions, slots, kd
+                    )
+                    tokens_host = np.asarray(jax.device_get(tokens))
+                block_host = tokens_host[None, :]
+                positions = positions + 1
+                emitted = 1
+            remaining -= emitted
+            for t in range(emitted):
+                for i in range(nreq):
+                    if not done[i]:
+                        out[i].append(int(block_host[t, i]))
+                        if (
+                            gen.eos_token_id is not None
+                            and out[i][-1] == gen.eos_token_id
+                        ):
+                            done[i] = True
         bench.e2e.record(time.perf_counter() - t_start)
         return GenerateResult(sequences=out, benchmark=bench)
 

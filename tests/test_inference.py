@@ -354,3 +354,64 @@ def test_eos_stops_generation(params, prompt):
         ),
     ).sequences[0]
     assert unstopped == full
+
+
+def test_on_device_steps_matches_per_token_loop():
+    """chunked on-device decode (one program per N tokens) emits exactly the
+    per-token loop's greedy sequence, including EOS mid-chunk."""
+    import dataclasses as _dc
+
+    from neuronx_distributed_llama3_2_tpu.models.llama import LLAMA_CONFIGS, LlamaForCausalLM
+
+    cfg = _dc.replace(LLAMA_CONFIGS["tiny"], loss_chunk_size=None)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0))
+    eng = InferenceEngine(cfg, params, max_batch=2, max_seq_len=128)
+    prompts = [
+        list(np.random.default_rng(0).integers(0, cfg.vocab_size, 9)),
+        list(np.random.default_rng(1).integers(0, cfg.vocab_size, 5)),
+    ]
+    ref = eng.generate(prompts, GenerationConfig(max_new_tokens=21)).sequences
+    got = eng.generate(
+        prompts, GenerationConfig(max_new_tokens=21, on_device_steps=4)
+    ).sequences
+    assert got == ref
+    # EOS inside a chunk truncates identically
+    eos = ref[0][2]
+    ref_e = eng.generate(
+        prompts, GenerationConfig(max_new_tokens=21, eos_token_id=eos)
+    ).sequences
+    got_e = eng.generate(
+        prompts,
+        GenerationConfig(max_new_tokens=21, eos_token_id=eos, on_device_steps=4),
+    ).sequences
+    assert got_e == ref_e
+
+
+def test_on_device_steps_sampling_rng_parity():
+    """Stochastic sampling: the chunked path consumes the SAME rng chain as
+    the host loop (one split per token), so seeds reproduce across
+    on_device_steps settings; aot_compile pre-builds the chunk program."""
+    import dataclasses as _dc
+
+    from neuronx_distributed_llama3_2_tpu.inference.sampling import SamplingConfig
+    from neuronx_distributed_llama3_2_tpu.models.llama import LLAMA_CONFIGS, LlamaForCausalLM
+
+    cfg = _dc.replace(LLAMA_CONFIGS["tiny"], loss_chunk_size=None)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0))
+    eng = InferenceEngine(cfg, params, max_batch=1, max_seq_len=64)
+    sampling = SamplingConfig(greedy=False, temperature=1.0, top_k=8)
+    eng.aot_compile(sampling=sampling, on_device_steps=(4,))
+    assert ("decode_multi", 1, sampling, 4) in eng._programs
+    prompts = [list(np.random.default_rng(2).integers(0, cfg.vocab_size, 6))]
+    ref = eng.generate(
+        prompts, GenerationConfig(max_new_tokens=13, sampling=sampling, seed=5)
+    ).sequences
+    got = eng.generate(
+        prompts,
+        GenerationConfig(
+            max_new_tokens=13, sampling=sampling, seed=5, on_device_steps=4
+        ),
+    ).sequences
+    assert got == ref
