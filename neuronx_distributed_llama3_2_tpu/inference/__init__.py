@@ -23,7 +23,12 @@ from neuronx_distributed_llama3_2_tpu.inference.engine import (
     default_buckets,
     pick_bucket,
 )
-from neuronx_distributed_llama3_2_tpu.inference.model import KVCache, LlamaDecode
+from neuronx_distributed_llama3_2_tpu.inference.model import (
+    KVCache,
+    LlamaDecode,
+    MixtralDecode,
+    decode_model_for,
+)
 from neuronx_distributed_llama3_2_tpu.inference.sampling import (
     SamplingConfig,
     sample,
@@ -61,9 +66,11 @@ __all__ = [
     "MedusaDecoder",
     "MedusaHeads",
     "MedusaResult",
+    "MixtralDecode",
     "MllamaCache",
     "MllamaDecoder",
     "SamplingConfig",
+    "decode_model_for",
     "SpeculativeDecoder",
     "SpeculativeResult",
     "benchmark_generation",

@@ -34,7 +34,11 @@ import numpy as np
 from neuronx_distributed_llama3_2_tpu.inference.benchmark import (
     GenerationBenchmark,
 )
-from neuronx_distributed_llama3_2_tpu.inference.model import KVCache, LlamaDecode
+from neuronx_distributed_llama3_2_tpu.inference.model import (
+    KVCache,
+    LlamaDecode,
+    decode_model_for,
+)
 from neuronx_distributed_llama3_2_tpu.inference.sampling import (
     SamplingConfig,
     sample,
@@ -106,7 +110,7 @@ class InferenceEngine:
         cache_dtype: Any = None,
     ) -> None:
         self.config = config
-        self.model = LlamaDecode(config)
+        self.model = decode_model_for(config)
         self.params = params
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
@@ -432,6 +436,15 @@ class ContinuousBatchingEngine:
     ) -> None:
         self.engine = engine
         self.gen = gen
+        if gen.on_device_steps > 1:
+            # admission + slot-recycling decisions happen on the host per
+            # token; a multi-token device loop would stall new requests for
+            # its whole chunk, so the serving loop always runs per-token
+            logger.warning(
+                "ContinuousBatchingEngine ignores on_device_steps=%d: the "
+                "slot scheduler admits/finishes requests per decode step",
+                gen.on_device_steps,
+            )
         self._next_rid = 0
         self._queue: List[_Request] = []
         self._active: Dict[int, _Request] = {}  # slot -> request

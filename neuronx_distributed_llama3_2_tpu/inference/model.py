@@ -208,7 +208,6 @@ class LlamaDecode:
         c = self.config
         from neuronx_distributed_llama3_2_tpu.models.llama import (
             LlamaAttention,
-            LlamaMLP,
         )
 
         attn = LlamaAttention(c)
@@ -258,8 +257,15 @@ class LlamaDecode:
         att = att.reshape(b, t, c.num_heads * c.head_dim)
         x = x + attn._o()(lp["attn"]["o"], att)
         h = norm(lp["mlp_norm"], x)
-        x = x + LlamaMLP(c)(lp["mlp"], h)
+        x = x + self._mlp_block(lp, h)
         return x, kc, vc
+
+    def _mlp_block(self, lp: Params, h: jax.Array) -> jax.Array:
+        """Post-attention feed-forward on the normed hidden (b,T,H).
+        Overridden by :class:`MixtralDecode` with the MoE block."""
+        from neuronx_distributed_llama3_2_tpu.models.llama import LlamaMLP
+
+        return LlamaMLP(self.config)(lp["mlp"], h)
 
     def _cache_attention(self, q, k_all, v_all, pos_block, ha, positions=None, tree=None):
         """q (b,T,N,D) against full cache rows (b,S_max,NKV,D) with the mask
@@ -296,3 +302,58 @@ class LlamaDecode:
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bnst,btnd->bsnd", probs, v_all)
         return constrain(out, P(BATCH_AXES, None, ha, None))
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralDecode(LlamaDecode):
+    """Decode-mode Mixtral: LlamaDecode attention/cache machinery with the
+    dense MLP swapped for the MoE block (reference Mixtral inference model,
+    ``examples/inference/mixtral/neuron_modeling_mixtral.py``, whose attention
+    is the Llama base + MoE feed-forward).
+
+    Token-gen dispatches through :meth:`..moe.ExpertMLPs.forward_selective`
+    (the reference's selective expert loading, expert_mlps.py:267) whenever
+    the fresh block is small enough that gathering the chosen experts reads
+    less HBM than streaming all of them; larger (prefill) blocks run the
+    batched all-experts path. Inference never drops tokens — the training
+    config's capacity factor is ignored here, so big-bucket MoE prefill pays
+    all-experts FLOPs (reference token-gen/context dispatch,
+    expert_mlps.py:298-357). Routing is per-token, so decode routing is
+    identical to the training model's. Expert parallelism is not supported
+    in decode (the reference's Mixtral inference is TP-only as well).
+    """
+
+    def _mlp_block(self, lp: Params, h: jax.Array) -> jax.Array:
+        import dataclasses as _dc
+
+        from neuronx_distributed_llama3_2_tpu.moe.model import MoE
+        from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+
+        if (
+            parallel_state.model_parallel_is_initialized()
+            and parallel_state.get_expert_model_parallel_size() > 1
+        ):
+            raise NotImplementedError(
+                "MixtralDecode does not support expert parallelism: decode "
+                "under an ep>1 mesh would allgather every EP-sharded expert "
+                "weight per token. Serve MoE models with tp/dp sharding."
+            )
+        b, t, hd = h.shape
+        moe = MoE(self.config.moe_config())
+        # capacity_factor=None routes through the selective/all-experts
+        # no-drop dispatch in ExpertMLPs.__call__ (single dispatch site)
+        experts = _dc.replace(moe._experts(), capacity_factor=None)
+        x_flat = h.reshape(b * t, hd)
+        _, gates, idx = moe._route(lp["moe"]["router"], x_flat)
+        y = experts(lp["moe"]["experts"], x_flat, gates, idx)
+        return y.reshape(b, t, hd)
+
+
+def decode_model_for(config) -> LlamaDecode:
+    """Pick the decode-model class for a training config (the engine-side
+    analogue of the reference's per-family NeuronXxxForCausalLM dispatch)."""
+    from neuronx_distributed_llama3_2_tpu.models.mixtral import MixtralConfig
+
+    if isinstance(config, MixtralConfig):
+        return MixtralDecode(config)
+    return LlamaDecode(config)

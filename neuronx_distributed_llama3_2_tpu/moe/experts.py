@@ -168,9 +168,38 @@ class ExpertMLPs:
         y = self._mlp(params, buf)
         return self.combine(y, slot, keep, gates, t)
 
+    def forward_selective(
+        self, params: Params, x: jax.Array, gates: jax.Array, idx: jax.Array
+    ) -> jax.Array:
+        """Token-gen path: gather only each token's chosen expert weights
+        (reference ``forward_selective_loading`` expert_mlps.py:267, which
+        loads just the selected experts from HBM during decode).
+
+        On TPU the win is the same currency — HBM traffic: decode is
+        bandwidth-bound, and for T tokens this reads T·k experts' weights
+        instead of all E (a k·T/E reduction; at Mixtral's T=1, k=2, E=8 that
+        is 4× less weight traffic per MoE layer). x (T,H), gates/idx (T,k).
+        """
+        t, k = idx.shape
+        # (T,k,H,n_up,I) / (T,k,I,H) dynamic gathers of whole-expert slices
+        w_gu = jnp.take(params["gate_up"], idx, axis=0)
+        w_dn = jnp.take(params["down"], idx, axis=0)
+        h1 = jnp.einsum("th,tkhui->tkui", x, w_gu)
+        if self.glu:
+            act = jax.nn.silu(h1[:, :, 0]) * h1[:, :, 1]
+        else:
+            act = jax.nn.silu(h1[:, :, 0])
+        y = jnp.einsum("tki,tkih->tkh", act, w_dn)  # (T,k,H)
+        return jnp.sum(y * gates[:, :, None].astype(y.dtype), axis=1)
+
     def __call__(
         self, params: Params, x: jax.Array, gates: jax.Array, idx: jax.Array
     ) -> jax.Array:
         if self.capacity_factor is None:
+            # selective wins exactly when it gathers fewer expert-weight
+            # bytes than streaming all E experts (the role of the reference's
+            # SELECTIVE_LOADING_THRESHOLD dispatch, expert_mlps.py:298-357)
+            if x.shape[0] * idx.shape[1] <= self.num_experts:
+                return self.forward_selective(params, x, gates, idx)
             return self.forward_all_experts(params, x, gates, idx)
         return self.forward_capacity_factor(params, x, gates, idx)
