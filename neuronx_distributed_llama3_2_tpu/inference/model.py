@@ -44,9 +44,9 @@ from jax.sharding import PartitionSpec as P
 from neuronx_distributed_llama3_2_tpu.models.llama import (
     LlamaConfig,
     LlamaForCausalLM,
-    RMSNorm,
     _head_axis,
     apply_rope,
+    make_norm,
     precompute_rope,
 )
 from neuronx_distributed_llama3_2_tpu.parallel.layers import (
@@ -166,7 +166,7 @@ class LlamaDecode:
 
         x = model._embed()(params["embed"], tokens)
         x = constrain(x, P(BATCH_AXES, None, None))
-        norm = RMSNorm(c.hidden_size, c.rms_norm_eps, c.dtype)
+        norm = make_norm(c)
 
         def layer_body(x, layer_in):
             lp, kc, vc = layer_in
@@ -211,11 +211,15 @@ class LlamaDecode:
         )
 
         attn = LlamaAttention(c)
-        norm = RMSNorm(c.hidden_size, c.rms_norm_eps, c.dtype)
+        norm = make_norm(c)
         b, t, _ = x.shape
 
         h = norm(lp["attn_norm"], x)
         q, k, v = attn._qkv()(lp["attn"]["qkv"], h)
+        if c.clip_qkv is not None:
+            q = jnp.clip(q, -c.clip_qkv, c.clip_qkv)
+            k = jnp.clip(k, -c.clip_qkv, c.clip_qkv)
+            v = jnp.clip(v, -c.clip_qkv, c.clip_qkv)
         q = q.reshape(b, t, c.num_heads, c.head_dim)
         k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
         v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
@@ -324,8 +328,6 @@ class MixtralDecode(LlamaDecode):
     """
 
     def _mlp_block(self, lp: Params, h: jax.Array) -> jax.Array:
-        import dataclasses as _dc
-
         from neuronx_distributed_llama3_2_tpu.moe.model import MoE
         from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
 
@@ -338,15 +340,11 @@ class MixtralDecode(LlamaDecode):
                 "under an ep>1 mesh would allgather every EP-sharded expert "
                 "weight per token. Serve MoE models with tp/dp sharding."
             )
-        b, t, hd = h.shape
-        moe = MoE(self.config.moe_config())
         # capacity_factor=None routes through the selective/all-experts
         # no-drop dispatch in ExpertMLPs.__call__ (single dispatch site)
-        experts = _dc.replace(moe._experts(), capacity_factor=None)
-        x_flat = h.reshape(b * t, hd)
-        _, gates, idx = moe._route(lp["moe"]["router"], x_flat)
-        y = experts(lp["moe"]["experts"], x_flat, gates, idx)
-        return y.reshape(b, t, hd)
+        cfg = dataclasses.replace(self.config.moe_config(), capacity_factor=None)
+        y, _, _ = MoE(cfg)(lp["moe"], h)
+        return y
 
 
 def decode_model_for(config) -> LlamaDecode:

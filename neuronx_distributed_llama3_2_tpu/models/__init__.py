@@ -7,6 +7,13 @@ from neuronx_distributed_llama3_2_tpu.models.mixtral import (  # noqa: F401
     MIXTRAL_CONFIGS,
     MixtralConfig,
     MixtralForCausalLM,
+    params_from_hf_mixtral,
+)
+from neuronx_distributed_llama3_2_tpu.models.dbrx import (  # noqa: F401
+    DBRX_CONFIGS,
+    DbrxConfig,
+    DbrxForCausalLM,
+    params_from_hf_dbrx,
 )
 from neuronx_distributed_llama3_2_tpu.models.mllama import (  # noqa: F401
     MllamaConfig,

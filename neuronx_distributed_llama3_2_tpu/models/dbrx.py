@@ -1,0 +1,140 @@
+"""DBRX model family (MoE), TPU-native.
+
+Counterpart of the reference's DBRX inference model
+(``examples/inference/dbrx/neuron_modeling_dbrx.py``): Llama-style GQA
+attention with a fused Wqkv and ``clip_qkv`` clamping (:171), bias-free
+LayerNorm instead of RMSNorm (:216-217), and a 16-expert top-4 MoE
+feed-forward with normalized top-k affinities (:208). All of that is
+expressed as config on the shared Llama/Mixtral block machinery
+(``norm_type="layernorm"``, ``clip_qkv``), so training (TP/SP/EP/ZeRO-1,
+pipeline) and KV-cache decode (:class:`..inference.MixtralDecode` — DBRX is
+a ``MixtralConfig`` subclass, dispatched automatically) work unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_llama3_2_tpu.models.mixtral import (
+    MixtralConfig,
+    MixtralForCausalLM,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class DbrxConfig(MixtralConfig):
+    """MixtralConfig with DBRX defaults (HF ``databricks/dbrx-base``
+    config.json: DbrxAttentionConfig.clip_qkv, DbrxFFNConfig
+    moe_num_experts/moe_top_k/moe_normalize_expert_weights)."""
+
+    norm_type: str = "layernorm"
+    norm_bias: bool = False
+    clip_qkv: float = 8.0
+    num_experts: int = 16
+    top_k: int = 4
+    router_aux_loss_coef: float = 0.05
+
+
+DBRX_CONFIGS: Dict[str, DbrxConfig] = {
+    # databricks/dbrx-base config.json values
+    "dbrx": DbrxConfig(
+        vocab_size=100352, hidden_size=6144, intermediate_size=10752,
+        num_layers=40, num_heads=48, num_kv_heads=8, head_dim=128,
+        max_seq_len=32768, rope_theta=500000.0, tie_word_embeddings=False,
+        num_experts=16, top_k=4, capacity_factor=8.0,
+    ),
+    "tiny-dbrx": DbrxConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=8, num_kv_heads=4, head_dim=8,
+        max_seq_len=128, rope_theta=10000.0, dtype=jnp.float32,
+        remat="none", num_experts=4, top_k=2,
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class DbrxForCausalLM(MixtralForCausalLM):
+    """DBRX = the Mixtral MoE causal LM running under a DbrxConfig (the
+    block differences — LayerNorm, clip_qkv, expert/top-k counts — are all
+    config-driven)."""
+
+    config: DbrxConfig
+
+
+def params_from_hf_dbrx(state_dict: Dict[str, Any], config: DbrxConfig) -> Params:
+    """Convert an HF DBRX ``state_dict`` to the stacked pytree.
+
+    HF layout (the reference converts the same names,
+    neuron_modeling_dbrx.py:68-102): fused ``Wqkv`` rows are [q; k; v];
+    ``DbrxExpertGLU`` stores w1/v1/w2 stacked as (E·ffn, d) with forward
+    ``(silu(x @ w1ᵉᵀ) * (x @ v1ᵉᵀ)) @ w2ᵉ``, so gate = w1ᵉᵀ, up = v1ᵉᵀ and
+    down = w2ᵉ verbatim."""
+
+    def t(name):
+        w = state_dict[name]
+        if hasattr(w, "detach"):
+            w = w.detach().cpu().numpy()
+        return np.asarray(w, dtype=np.float32)
+
+    c = config
+    L, E, H, I = c.num_layers, c.num_experts, c.hidden_size, c.intermediate_size
+    q_dim = c.num_heads * c.head_dim
+    kv_dim = c.num_kv_heads * c.head_dim
+
+    qs, ks, vs, os_, n1, n2, routers, gate_ups, downs = (
+        [], [], [], [], [], [], [], [], []
+    )
+    for i in range(L):
+        blk = f"transformer.blocks.{i}"
+        wqkv = t(f"{blk}.norm_attn_norm.attn.Wqkv.weight")  # (q+2kv, H)
+        qs.append(wqkv[:q_dim].T)
+        ks.append(wqkv[q_dim : q_dim + kv_dim].T)
+        vs.append(wqkv[q_dim + kv_dim :].T)
+        os_.append(t(f"{blk}.norm_attn_norm.attn.out_proj.weight").T)
+        n1.append(t(f"{blk}.norm_attn_norm.norm_1.weight"))
+        n2.append(t(f"{blk}.norm_attn_norm.norm_2.weight"))
+        routers.append(t(f"{blk}.ffn.router.layer.weight").T)  # (H, E)
+        w1 = t(f"{blk}.ffn.experts.mlp.w1").reshape(E, I, H)
+        v1 = t(f"{blk}.ffn.experts.mlp.v1").reshape(E, I, H)
+        w2 = t(f"{blk}.ffn.experts.mlp.w2").reshape(E, I, H)
+        # gate_up (E, H, 2, I): [:, :, 0] = gate (w1ᵀ), [:, :, 1] = up (v1ᵀ)
+        gate_ups.append(
+            np.stack([w1.transpose(0, 2, 1), v1.transpose(0, 2, 1)], axis=2)
+        )
+        downs.append(w2)  # (E, I, H)
+
+    dt = c.dtype
+    params: Params = {
+        "embed": {"embedding": jnp.asarray(t("transformer.wte.weight"), dt)},
+        "layers": {
+            "attn_norm": {"scale": jnp.asarray(np.stack(n1), jnp.float32)},
+            "attn": {
+                "qkv": {
+                    "q_kernel": jnp.asarray(np.stack(qs), dt),
+                    "k_kernel": jnp.asarray(np.stack(ks), dt),
+                    "v_kernel": jnp.asarray(np.stack(vs), dt),
+                },
+                "o": {"kernel": jnp.asarray(np.stack(os_), dt)},
+            },
+            "mlp_norm": {"scale": jnp.asarray(np.stack(n2), jnp.float32)},
+            "moe": {
+                "router": {"kernel": jnp.asarray(np.stack(routers), jnp.float32)},
+                "experts": {
+                    "gate_up": jnp.asarray(np.stack(gate_ups), dt),
+                    "down": jnp.asarray(np.stack(downs), dt),
+                },
+            },
+        },
+        "final_norm": {
+            "scale": jnp.asarray(t("transformer.norm_f.weight"), jnp.float32)
+        },
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = {"kernel": jnp.asarray(t("lm_head.weight").T, dt)}
+    return params

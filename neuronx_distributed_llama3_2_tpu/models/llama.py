@@ -88,6 +88,14 @@ class LlamaConfig:
     # chunk the LM head + CE over the sequence so full (B,S,V) logits never
     # materialize; None disables (loss-memory redesign, no reference analogue)
     loss_chunk_size: Optional[int] = None
+    # "rmsnorm" (Llama/Mixtral) | "layernorm" (DBRX/GPT-NeoX family models,
+    # reference NeuronDbrxBlock uses nn.LayerNorm(bias=False),
+    # neuron_modeling_dbrx.py:216-217)
+    norm_type: str = "rmsnorm"
+    norm_bias: bool = False
+    # clamp Q/K/V projections to [-clip_qkv, clip_qkv] (DBRX attn_config,
+    # reference neuron_modeling_dbrx.py:171)
+    clip_qkv: Optional[float] = None
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -97,6 +105,10 @@ class LlamaConfig:
         if self.remat not in ("none", "full", "selective", "hybrid", "kv", "dots"):
             raise ValueError(
                 f"remat must be none/full/selective/hybrid/kv/dots, got {self.remat!r}"
+            )
+        if self.norm_type not in ("rmsnorm", "layernorm"):
+            raise ValueError(
+                f"norm_type must be rmsnorm|layernorm, got {self.norm_type!r}"
             )
 
 
@@ -163,6 +175,54 @@ class RMSNorm:
         var = jnp.mean(jnp.square(h), axis=-1, keepdims=True)
         h = h * lax.rsqrt(var + self.eps)
         return (h * params["scale"]).astype(self.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerNorm:
+    """Mean-centered layer norm in fp32 accumulation, optional bias —
+    the DBRX/GPT-NeoX-family norm (reference NeuronDbrxBlock
+    neuron_modeling_dbrx.py:216-217 uses ``nn.LayerNorm(bias=False)``).
+    Same param protocol as :class:`RMSNorm`."""
+
+    dim: int
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    bias: bool = False
+
+    def init(self, key: jax.Array) -> Params:
+        del key
+        p = {"scale": jnp.ones((self.dim,), jnp.float32)}
+        if self.bias:
+            p["bias"] = jnp.zeros((self.dim,), jnp.float32)
+        return p
+
+    def specs(self) -> Params:
+        s = {"scale": P(None)}
+        if self.bias:
+            s["bias"] = P(None)
+        return s
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        h = x.astype(jnp.float32)
+        mean = jnp.mean(h, axis=-1, keepdims=True)
+        h = h - mean
+        var = jnp.mean(jnp.square(h), axis=-1, keepdims=True)
+        h = h * lax.rsqrt(var + self.eps)
+        h = h * params["scale"]
+        if self.bias:
+            h = h + params["bias"]
+        return h.astype(self.dtype)
+
+
+def make_norm(config: "LlamaConfig"):
+    """Norm block per ``config.norm_type`` (one construction site for every
+    model family sharing the Llama block machinery)."""
+    if config.norm_type == "layernorm":
+        return LayerNorm(
+            config.hidden_size, config.rms_norm_eps, config.dtype,
+            bias=config.norm_bias,
+        )
+    return RMSNorm(config.hidden_size, config.rms_norm_eps, config.dtype)
 
 
 def precompute_rope(
@@ -310,6 +370,10 @@ class LlamaAttention:
         b = x.shape[0]
         qkv_layer = self._qkv()
         q, k, v = qkv_layer(params["qkv"], x)
+        if c.clip_qkv is not None:
+            q = jnp.clip(q, -c.clip_qkv, c.clip_qkv)
+            k = jnp.clip(k, -c.clip_qkv, c.clip_qkv)
+            v = jnp.clip(v, -c.clip_qkv, c.clip_qkv)
         s = q.shape[1]  # global seq len (post SP all-gather under GSPMD)
         q = q.reshape(b, s, c.num_heads, c.head_dim)
         k = k.reshape(b, s, c.num_kv_heads, c.head_dim)
@@ -439,7 +503,7 @@ class LlamaDecoderLayer:
 
     def _norm(self) -> RMSNorm:
         c = self.config
-        return RMSNorm(c.hidden_size, c.rms_norm_eps, c.dtype)
+        return make_norm(c)
 
     def init(self, key: jax.Array) -> Params:
         ka, km = jax.random.split(key)
@@ -521,7 +585,7 @@ class LlamaForCausalLM:
 
     def _norm(self) -> RMSNorm:
         c = self.config
-        return RMSNorm(c.hidden_size, c.rms_norm_eps, c.dtype)
+        return make_norm(c)
 
     def init(self, key: jax.Array) -> Params:
         c = self.config

@@ -31,8 +31,8 @@ from neuronx_distributed_llama3_2_tpu.models.llama import (
     LlamaAttention,
     LlamaConfig,
     LlamaForCausalLM,
-    RMSNorm,
     _remat_policy,
+    make_norm,
     precompute_rope,
 )
 from neuronx_distributed_llama3_2_tpu.moe.loss import load_balancing_loss
@@ -90,9 +90,8 @@ MIXTRAL_CONFIGS: Dict[str, MixtralConfig] = {
 class MixtralDecoderLayer:
     config: MixtralConfig
 
-    def _norm(self) -> RMSNorm:
-        c = self.config
-        return RMSNorm(c.hidden_size, c.rms_norm_eps, c.dtype)
+    def _norm(self):
+        return make_norm(self.config)
 
     def _moe(self) -> MoE:
         return MoE(self.config.moe_config())
@@ -228,3 +227,85 @@ class MixtralForCausalLM:
         hidden, aux = self._backbone(params, input_ids)
         ce = self._llama().loss_from_hidden(params, hidden, labels)
         return ce + self.config.router_aux_loss_coef * aux
+
+
+def params_from_hf_mixtral(
+    state_dict: Dict[str, Any], config: MixtralConfig
+) -> Params:
+    """Convert an HF Mixtral ``state_dict`` to the stacked pytree.
+
+    HF ``MixtralSparseMoeBlock``: per-expert w1 (gate, (I,H)), w3 (up, (I,H)),
+    w2 (down, (H,I)); router ``gate.weight`` (E,H). Attention maps exactly as
+    Llama (same GQA block)."""
+    import numpy as np
+
+    def t(name):
+        w = state_dict[name]
+        if hasattr(w, "detach"):
+            w = w.detach().cpu().numpy()
+        return np.asarray(w, dtype=np.float32)
+
+    c = config
+    L, E = c.num_layers, c.num_experts
+
+    def stack(fmt, transform=lambda w: w.T, dtype=None):
+        return jnp.asarray(
+            np.stack([transform(t(fmt.format(i))) for i in range(L)]),
+            dtype or c.dtype,
+        )
+
+    gate_ups, downs, routers = [], [], []
+    for i in range(L):
+        moe = f"model.layers.{i}.block_sparse_moe"
+        routers.append(t(f"{moe}.gate.weight").T)  # (H, E)
+        gate = np.stack([t(f"{moe}.experts.{e}.w1.weight").T for e in range(E)])
+        up = np.stack([t(f"{moe}.experts.{e}.w3.weight").T for e in range(E)])
+        gate_ups.append(np.stack([gate, up], axis=2))  # (E, H, 2, I)
+        downs.append(
+            np.stack([t(f"{moe}.experts.{e}.w2.weight").T for e in range(E)])
+        )  # (E, I, H)
+
+    params: Params = {
+        "embed": {
+            "embedding": jnp.asarray(t("model.embed_tokens.weight"), c.dtype)
+        },
+        "layers": {
+            "attn_norm": {
+                "scale": stack(
+                    "model.layers.{}.input_layernorm.weight",
+                    transform=lambda w: w, dtype=jnp.float32,
+                )
+            },
+            "attn": {
+                "qkv": {
+                    "q_kernel": stack("model.layers.{}.self_attn.q_proj.weight"),
+                    "k_kernel": stack("model.layers.{}.self_attn.k_proj.weight"),
+                    "v_kernel": stack("model.layers.{}.self_attn.v_proj.weight"),
+                },
+                "o": {"kernel": stack("model.layers.{}.self_attn.o_proj.weight")},
+            },
+            "mlp_norm": {
+                "scale": stack(
+                    "model.layers.{}.post_attention_layernorm.weight",
+                    transform=lambda w: w, dtype=jnp.float32,
+                )
+            },
+            "moe": {
+                "router": {
+                    "kernel": jnp.asarray(np.stack(routers), jnp.float32)
+                },
+                "experts": {
+                    "gate_up": jnp.asarray(np.stack(gate_ups), c.dtype),
+                    "down": jnp.asarray(np.stack(downs), c.dtype),
+                },
+            },
+        },
+        "final_norm": {
+            "scale": jnp.asarray(t("model.norm.weight"), jnp.float32)
+        },
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = {
+            "kernel": jnp.asarray(t("lm_head.weight").T, c.dtype)
+        }
+    return params
