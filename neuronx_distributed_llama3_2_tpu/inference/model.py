@@ -350,8 +350,17 @@ class MixtralDecode(LlamaDecode):
 def decode_model_for(config) -> LlamaDecode:
     """Pick the decode-model class for a training config (the engine-side
     analogue of the reference's per-family NeuronXxxForCausalLM dispatch)."""
+    from neuronx_distributed_llama3_2_tpu.models.gptneox import GPTNeoXConfig
     from neuronx_distributed_llama3_2_tpu.models.mixtral import MixtralConfig
 
+    if isinstance(config, GPTNeoXConfig):
+        # parallel-residual blocks + partial rotary don't match the Llama
+        # decode layer; refusing beats silently-wrong generation (the
+        # reference likewise has no GPT-NeoX/CodeGen inference model)
+        raise NotImplementedError(
+            "KV-cache decode is not implemented for the GPT-NeoX/CodeGen "
+            "family; use the training model's full forward"
+        )
     if isinstance(config, MixtralConfig):
         return MixtralDecode(config)
     return LlamaDecode(config)

@@ -15,6 +15,19 @@ from neuronx_distributed_llama3_2_tpu.models.dbrx import (  # noqa: F401
     DbrxForCausalLM,
     params_from_hf_dbrx,
 )
+from neuronx_distributed_llama3_2_tpu.models.bert import (  # noqa: F401
+    BERT_CONFIGS,
+    BertConfig,
+    BertForPreTraining,
+    params_from_hf_bert,
+)
+from neuronx_distributed_llama3_2_tpu.models.gptneox import (  # noqa: F401
+    GPTNEOX_CONFIGS,
+    GPTNeoXConfig,
+    GPTNeoXForCausalLM,
+    params_from_hf_codegen,
+    params_from_hf_neox,
+)
 from neuronx_distributed_llama3_2_tpu.models.mllama import (  # noqa: F401
     MllamaConfig,
     MllamaForConditionalGeneration,

@@ -1,0 +1,469 @@
+"""BERT family (TP MLM/NSP pretraining), TPU-native.
+
+Counterpart of the reference's BERT-large TP+DP pretraining example
+(SURVEY.md §2.8, ``examples/training/tp_dp_bert_hf_pretrain``, 846 LoC):
+bidirectional post-LayerNorm encoder with learned positions, MLM head
+(transform + tied decoder + vocab-parallel CE over masked positions) and NSP
+head. TP sharding comes from the same parallel layer library as the decoder
+families; there is no rope/causal machinery to inherit, so the encoder block
+is defined here rather than on the Llama base.
+
+Protocol: ``loss(params, input_ids, labels)`` is the MLM-only objective (the
+trainer's generic batch interface); ``pretraining_loss`` adds token types,
+padding mask, and the NSP term for full-parity pretraining.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LayerNorm,
+    _remat_policy,
+    core_attention,
+)
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+    BATCH_AXES,
+    ColumnParallelLinear,
+    GQAQKVColumnParallelLinear,
+    ParallelEmbedding,
+    RowParallelLinear,
+    constrain,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.loss import (
+    parallel_cross_entropy,
+    valid_token_mask,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.state import TP_AXIS
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    """HF BertConfig fields the reference example trains from."""
+
+    vocab_size: int = 30522
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    remat: str = "none"
+    scan_layers: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+BERT_CONFIGS: Dict[str, BertConfig] = {
+    # bert-large-uncased (the reference example's target model)
+    "bert-large": BertConfig(),
+    "bert-base": BertConfig(
+        hidden_size=768, intermediate_size=3072, num_layers=12, num_heads=12
+    ),
+    "tiny-bert": BertConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=4,
+        num_heads=8, max_position_embeddings=128, dtype=jnp.float32,
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class BertEmbeddings:
+    config: BertConfig
+
+    def _norm(self) -> LayerNorm:
+        c = self.config
+        return LayerNorm(c.hidden_size, c.layer_norm_eps, c.dtype, bias=True)
+
+    def _word(self) -> ParallelEmbedding:
+        c = self.config
+        return ParallelEmbedding(c.vocab_size, c.hidden_size, dtype=c.dtype)
+
+    def init(self, key: jax.Array) -> Params:
+        c = self.config
+        kw, kp, kt = jax.random.split(key, 3)
+        scale = 0.02
+        return {
+            "word": self._word().init(kw),
+            "position": (
+                jax.random.normal(
+                    kp, (c.max_position_embeddings, c.hidden_size), jnp.float32
+                ) * scale
+            ).astype(c.dtype),
+            "token_type": (
+                jax.random.normal(
+                    kt, (c.type_vocab_size, c.hidden_size), jnp.float32
+                ) * scale
+            ).astype(c.dtype),
+            "norm": self._norm().init(key),
+        }
+
+    def specs(self) -> Params:
+        return {
+            "word": self._word().specs(),
+            "position": P(None, None),
+            "token_type": P(None, None),
+            "norm": self._norm().specs(),
+        }
+
+    def __call__(
+        self, params: Params, input_ids: jax.Array, token_type_ids: jax.Array
+    ) -> jax.Array:
+        s = input_ids.shape[1]
+        x = self._word()(params["word"], input_ids)
+        x = x + params["position"][None, :s, :]
+        x = x + jnp.take(params["token_type"], token_type_ids, axis=0)
+        return self._norm()(params["norm"], x)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertLayer:
+    """Post-LN encoder layer: LN(x + attn(x)), LN(x + mlp(x))."""
+
+    config: BertConfig
+
+    def _norm(self) -> LayerNorm:
+        c = self.config
+        return LayerNorm(c.hidden_size, c.layer_norm_eps, c.dtype, bias=True)
+
+    def _qkv(self) -> GQAQKVColumnParallelLinear:
+        c = self.config
+        return GQAQKVColumnParallelLinear(
+            hidden_size=c.hidden_size, num_heads=c.num_heads,
+            num_kv_heads=c.num_heads, head_dim=c.head_dim,
+            use_bias=True, dtype=c.dtype,
+        )
+
+    def _attn_out(self) -> RowParallelLinear:
+        c = self.config
+        return RowParallelLinear(
+            in_features=c.hidden_size, out_features=c.hidden_size,
+            use_bias=True, dtype=c.dtype,
+        )
+
+    def _up(self) -> ColumnParallelLinear:
+        c = self.config
+        return ColumnParallelLinear(
+            in_features=c.hidden_size, out_features=c.intermediate_size,
+            use_bias=True, dtype=c.dtype,
+        )
+
+    def _down(self) -> RowParallelLinear:
+        c = self.config
+        return RowParallelLinear(
+            in_features=c.intermediate_size, out_features=c.hidden_size,
+            use_bias=True, dtype=c.dtype,
+        )
+
+    def init(self, key: jax.Array) -> Params:
+        kq, ko, ku, kd = jax.random.split(key, 4)
+        return {
+            "qkv": self._qkv().init(kq),
+            "attn_out": self._attn_out().init(ko),
+            "attn_norm": self._norm().init(key),
+            "up": self._up().init(ku),
+            "down": self._down().init(kd),
+            "mlp_norm": self._norm().init(key),
+        }
+
+    def specs(self) -> Params:
+        return {
+            "qkv": self._qkv().specs(),
+            "attn_out": self._attn_out().specs(),
+            "attn_norm": self._norm().specs(),
+            "up": self._up().specs(),
+            "down": self._down().specs(),
+            "mlp_norm": self._norm().specs(),
+        }
+
+    def __call__(
+        self, params: Params, x: jax.Array, mask_bias: Optional[jax.Array]
+    ) -> jax.Array:
+        c = self.config
+        b, s, _ = x.shape
+        q, k, v = self._qkv()(params["qkv"], x)
+        q = q.reshape(b, s, c.num_heads, c.head_dim)
+        k = k.reshape(b, s, c.num_heads, c.head_dim)
+        v = v.reshape(b, s, c.num_heads, c.head_dim)
+        att = core_attention(q, k, v, causal=False, bias=mask_bias)
+        att = att.reshape(b, s, c.hidden_size)
+        x = self._norm()(
+            params["attn_norm"], x + self._attn_out()(params["attn_out"], att)
+        )
+        h = self._up()(params["up"], x)
+        h = jax.nn.gelu(h.astype(jnp.float32), approximate=False).astype(c.dtype)
+        return self._norm()(params["mlp_norm"], x + self._down()(params["down"], h))
+
+
+@dataclasses.dataclass(frozen=True)
+class BertForPreTraining:
+    """MLM + NSP pretraining model (HF ``BertForPreTraining`` layout)."""
+
+    config: BertConfig
+
+    def _layer(self) -> BertLayer:
+        return BertLayer(self.config)
+
+    def _embeddings(self) -> BertEmbeddings:
+        return BertEmbeddings(self.config)
+
+    def _norm(self) -> LayerNorm:
+        c = self.config
+        return LayerNorm(c.hidden_size, c.layer_norm_eps, c.dtype, bias=True)
+
+    def _pooler(self) -> ColumnParallelLinear:
+        c = self.config
+        return ColumnParallelLinear(
+            in_features=c.hidden_size, out_features=c.hidden_size,
+            use_bias=True, gather_output=True, dtype=c.dtype,
+        )
+
+    def _transform(self) -> ColumnParallelLinear:
+        c = self.config
+        return ColumnParallelLinear(
+            in_features=c.hidden_size, out_features=c.hidden_size,
+            use_bias=True, gather_output=True, dtype=c.dtype,
+        )
+
+    def init(self, key: jax.Array) -> Params:
+        c = self.config
+        ke, kl, kp, kt, kn = jax.random.split(key, 5)
+        layer_keys = jax.random.split(kl, c.num_layers)
+        return {
+            "embeddings": self._embeddings().init(ke),
+            "layers": jax.vmap(self._layer().init)(layer_keys),
+            "pooler": self._pooler().init(kp),
+            "mlm_transform": self._transform().init(kt),
+            "mlm_norm": self._norm().init(kn),
+            # decoder weight is tied to the word embedding; only its bias
+            # is a free parameter (HF cls.predictions.bias)
+            "mlm_bias": jnp.zeros((c.vocab_size,), jnp.float32),
+            "nsp": {
+                "kernel": (
+                    jax.random.normal(kn, (c.hidden_size, 2), jnp.float32) * 0.02
+                ).astype(c.dtype),
+                "bias": jnp.zeros((2,), c.dtype),
+            },
+        }
+
+    def specs(self) -> Params:
+        layer_specs = jax.tree.map(
+            lambda s: P(None, *s), self._layer().specs(),
+            is_leaf=lambda s: isinstance(s, P),
+        )
+        return {
+            "embeddings": self._embeddings().specs(),
+            "layers": layer_specs,
+            "pooler": self._pooler().specs(),
+            "mlm_transform": self._transform().specs(),
+            "mlm_norm": self._norm().specs(),
+            "mlm_bias": P(None),
+            "nsp": {"kernel": P(None, None), "bias": P(None)},
+        }
+
+    def _encode(
+        self,
+        params: Params,
+        input_ids: jax.Array,
+        token_type_ids: Optional[jax.Array],
+        attention_mask: Optional[jax.Array],
+    ) -> jax.Array:
+        c = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = self._embeddings()(params["embeddings"], input_ids, token_type_ids)
+        x = constrain(x, P(BATCH_AXES, None, None))
+        mask_bias = None
+        if attention_mask is not None:
+            # (B, T) 1=keep -> additive (B, 1, 1, T)
+            mask_bias = (1.0 - attention_mask.astype(jnp.float32)) * -1e30
+            mask_bias = mask_bias[:, None, None, :]
+
+        layer = self._layer()
+
+        def body(x, lp):
+            return layer(lp, x, mask_bias), None
+
+        policy = _remat_policy(c.remat)
+        if policy is not None:
+            body = jax.checkpoint(body, policy=policy)
+        if c.scan_layers:
+            x, _ = lax.scan(body, x, params["layers"])
+        else:
+            for i in range(c.num_layers):
+                x, _ = body(x, jax.tree.map(lambda p: p[i], params["layers"]))
+        return x
+
+    def _mlm_logits(self, params: Params, hidden: jax.Array) -> jax.Array:
+        h = self._transform()(params["mlm_transform"], hidden)
+        h = jax.nn.gelu(h.astype(jnp.float32), approximate=False).astype(
+            self.config.dtype
+        )
+        h = self._norm()(params["mlm_norm"], h)
+        logits = jnp.einsum(
+            "bsh,vh->bsv", h, params["embeddings"]["word"]["embedding"]
+        )
+        logits = logits + params["mlm_bias"].astype(logits.dtype)
+        return constrain(logits, P(BATCH_AXES, None, TP_AXIS))
+
+    def _nsp_logits(self, params: Params, hidden: jax.Array) -> jax.Array:
+        pooled = jnp.tanh(self._pooler()(params["pooler"], hidden[:, 0, :]))
+        return (
+            pooled @ params["nsp"]["kernel"] + params["nsp"]["bias"]
+        ).astype(jnp.float32)
+
+    def __call__(
+        self,
+        params: Params,
+        input_ids: jax.Array,
+        token_type_ids: Optional[jax.Array] = None,
+        attention_mask: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Returns (prediction_logits (B,S,V), seq_relationship_logits (B,2))."""
+        hidden = self._encode(params, input_ids, token_type_ids, attention_mask)
+        return self._mlm_logits(params, hidden), self._nsp_logits(params, hidden)
+
+    def _mlm_loss(self, logits: jax.Array, labels: jax.Array) -> jax.Array:
+        """Unshifted masked-position CE; labels use -100 for unmasked."""
+        per_tok = parallel_cross_entropy(logits, labels)
+        valid = valid_token_mask(labels, self.config.vocab_size).astype(
+            jnp.float32
+        )
+        return jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+    def loss(
+        self, params: Params, input_ids: jax.Array, labels: jax.Array
+    ) -> jax.Array:
+        """MLM-only loss on the trainer's generic (input_ids, labels) batch
+        interface (labels unshifted, -100 = unmasked)."""
+        hidden = self._encode(params, input_ids, None, None)
+        return self._mlm_loss(self._mlm_logits(params, hidden), labels)
+
+    def pretraining_loss(self, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
+        """Full MLM + NSP objective (reference run_pretrain loss,
+        tp_dp_bert_hf_pretrain)."""
+        hidden = self._encode(
+            params,
+            batch["input_ids"],
+            batch.get("token_type_ids"),
+            batch.get("attention_mask"),
+        )
+        mlm = self._mlm_loss(
+            self._mlm_logits(params, hidden), batch["labels"]
+        )
+        nsp_logits = self._nsp_logits(params, hidden)
+        nsl = batch["next_sentence_label"]
+        nsp = -jnp.mean(
+            jnp.take_along_axis(
+                jax.nn.log_softmax(nsp_logits, axis=-1), nsl[:, None], axis=1
+            )[:, 0]
+        )
+        return mlm + nsp
+
+
+def params_from_hf_bert(state_dict: Dict[str, Any], config: BertConfig) -> Params:
+    """HF ``BertForPreTraining`` state dict → stacked pytree."""
+    import numpy as np
+
+    def t(name):
+        w = state_dict[name]
+        if hasattr(w, "detach"):
+            w = w.detach().cpu().numpy()
+        return np.asarray(w, dtype=np.float32)
+
+    c = config
+    L = c.num_layers
+    dt, f32 = c.dtype, jnp.float32
+
+    def st(fmt, transform=lambda w: w, dtype=None):
+        return jnp.asarray(
+            np.stack([transform(t(fmt.format(i))) for i in range(L)]),
+            dtype or dt,
+        )
+
+    pre = "bert.encoder.layer.{}"
+    return {
+        "embeddings": {
+            "word": {
+                "embedding": jnp.asarray(
+                    t("bert.embeddings.word_embeddings.weight"), dt
+                )
+            },
+            "position": jnp.asarray(
+                t("bert.embeddings.position_embeddings.weight"), dt
+            ),
+            "token_type": jnp.asarray(
+                t("bert.embeddings.token_type_embeddings.weight"), dt
+            ),
+            "norm": {
+                "scale": jnp.asarray(t("bert.embeddings.LayerNorm.weight"), f32),
+                "bias": jnp.asarray(t("bert.embeddings.LayerNorm.bias"), f32),
+            },
+        },
+        "layers": {
+            "qkv": {
+                "q_kernel": st(pre + ".attention.self.query.weight", lambda w: w.T),
+                "k_kernel": st(pre + ".attention.self.key.weight", lambda w: w.T),
+                "v_kernel": st(pre + ".attention.self.value.weight", lambda w: w.T),
+                "q_bias": st(pre + ".attention.self.query.bias"),
+                "k_bias": st(pre + ".attention.self.key.bias"),
+                "v_bias": st(pre + ".attention.self.value.bias"),
+            },
+            "attn_out": {
+                "kernel": st(pre + ".attention.output.dense.weight", lambda w: w.T),
+                "bias": st(pre + ".attention.output.dense.bias"),
+            },
+            "attn_norm": {
+                "scale": st(pre + ".attention.output.LayerNorm.weight", dtype=f32),
+                "bias": st(pre + ".attention.output.LayerNorm.bias", dtype=f32),
+            },
+            "up": {
+                "kernel": st(pre + ".intermediate.dense.weight", lambda w: w.T),
+                "bias": st(pre + ".intermediate.dense.bias"),
+            },
+            "down": {
+                "kernel": st(pre + ".output.dense.weight", lambda w: w.T),
+                "bias": st(pre + ".output.dense.bias"),
+            },
+            "mlp_norm": {
+                "scale": st(pre + ".output.LayerNorm.weight", dtype=f32),
+                "bias": st(pre + ".output.LayerNorm.bias", dtype=f32),
+            },
+        },
+        "pooler": {
+            "kernel": jnp.asarray(t("bert.pooler.dense.weight").T, dt),
+            "bias": jnp.asarray(t("bert.pooler.dense.bias"), dt),
+        },
+        "mlm_transform": {
+            "kernel": jnp.asarray(
+                t("cls.predictions.transform.dense.weight").T, dt
+            ),
+            "bias": jnp.asarray(t("cls.predictions.transform.dense.bias"), dt),
+        },
+        "mlm_norm": {
+            "scale": jnp.asarray(
+                t("cls.predictions.transform.LayerNorm.weight"), f32
+            ),
+            "bias": jnp.asarray(
+                t("cls.predictions.transform.LayerNorm.bias"), f32
+            ),
+        },
+        "mlm_bias": jnp.asarray(t("cls.predictions.bias"), f32),
+        "nsp": {
+            "kernel": jnp.asarray(t("cls.seq_relationship.weight").T, dt),
+            "bias": jnp.asarray(t("cls.seq_relationship.bias"), dt),
+        },
+    }

@@ -39,6 +39,9 @@ class DbrxConfig(MixtralConfig):
     num_experts: int = 16
     top_k: int = 4
     router_aux_loss_coef: float = 0.05
+    # every published DBRX checkpoint is untied; defaulting True (the Llama
+    # default) would make params_from_hf_dbrx silently drop lm_head
+    tie_word_embeddings: bool = False
 
 
 DBRX_CONFIGS: Dict[str, DbrxConfig] = {

@@ -302,13 +302,15 @@ def _head_axis(num: int) -> Optional[str]:
 
 
 def core_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference CoreAttention (modeling_llama_nxd.py:214): softmax(QK^T/√d)V
     with causal mask, softmax in fp32. q (B,S,N,D); k/v (B,S,Nkv,D) with
-    Nkv dividing N (GQA repeat happens here). Kept as a separable function so
-    remat policy can target it (reference selective checkpointing wraps
-    exactly this module)."""
+    Nkv dividing N (GQA repeat happens here). ``bias`` is an fp32 additive
+    mask broadcastable to (B, N, S, T) — e.g. a BERT padding mask. Kept as a
+    separable function so remat policy can target it (reference selective
+    checkpointing wraps exactly this module)."""
     b, s, n, d = q.shape
     nkv = k.shape[2]
     if nkv != n:
@@ -319,6 +321,8 @@ def core_attention(
     scores = jnp.einsum("bsnd,btnd->bnst", q, k) * (d ** -0.5)
     scores = constrain(scores, P(BATCH_AXES, ha, None, None))
     scores = scores.astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
     if causal:
         st = lax.iota(jnp.int32, s)[:, None]
         tt = lax.iota(jnp.int32, k.shape[1])[None, :]
@@ -358,6 +362,11 @@ class LlamaAttention:
     def specs(self) -> Params:
         return {"qkv": self._qkv().specs(), "o": self._o().specs()}
 
+    def _apply_rope(self, q, k, sin, cos, positions):
+        """Full-head-dim rotate-half RoPE; partial-rotary families
+        (GPT-NeoX/CodeGen) override."""
+        return apply_rope(q, sin, cos, positions), apply_rope(k, sin, cos, positions)
+
     def __call__(
         self,
         params: Params,
@@ -378,8 +387,7 @@ class LlamaAttention:
         q = q.reshape(b, s, c.num_heads, c.head_dim)
         k = k.reshape(b, s, c.num_kv_heads, c.head_dim)
         v = v.reshape(b, s, c.num_kv_heads, c.head_dim)
-        q = apply_rope(q, sin, cos, positions)
-        k = apply_rope(k, sin, cos, positions)
+        q, k = self._apply_rope(q, k, sin, cos, positions)
 
         # tp > kv_heads: repeat KV heads to tp granularity so the attention
         # activations shard 1 head/device instead of full replication — the
@@ -620,12 +628,19 @@ class LlamaForCausalLM:
     def _sp_enabled(self) -> bool:
         return parallel_state.sequence_parallel_enabled()
 
+    def _rope(self, s: int):
+        """Rope tables shared across layers (reference sin/cos sharing,
+        tp_zero1_llama_hf_pretrain.py:151-158). Overridden by partial-rotary
+        families (GPT-NeoX/CodeGen)."""
+        c = self.config
+        return precompute_rope(c.head_dim, s, c.rope_theta, c.rope_scaling)
+
     def _backbone(self, params: Params, input_ids: jax.Array) -> jax.Array:
         """Embed + decoder stack + final norm → hidden states (B, S, H)."""
         c = self.config
         b, s = input_ids.shape
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-        sin, cos = precompute_rope(c.head_dim, s, c.rope_theta, c.rope_scaling)
+        sin, cos = self._rope(s)
         x = self._embed()(params["embed"], input_ids)
         if self._sp_enabled():
             # enter SP region: shard seq over tp (reference

@@ -1,0 +1,162 @@
+"""BERT family tests (reference tp_dp_bert_hf_pretrain example, SURVEY §2.8):
+HF CPU parity for MLM + NSP heads, TP-sharded parity, MLM train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.models import (
+    BERT_CONFIGS,
+    BertForPreTraining,
+    params_from_hf_bert,
+)
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.layers import shard_pytree
+
+TINY = BERT_CONFIGS["tiny-bert"]
+
+
+def _hf_bert():
+    import torch
+    from transformers import BertConfig as HFConfig
+    from transformers import BertForPreTraining as HFModel
+
+    cfg = HFConfig(
+        vocab_size=TINY.vocab_size, hidden_size=TINY.hidden_size,
+        num_hidden_layers=TINY.num_layers,
+        num_attention_heads=TINY.num_heads,
+        intermediate_size=TINY.intermediate_size,
+        max_position_embeddings=TINY.max_position_embeddings,
+        type_vocab_size=TINY.type_vocab_size,
+        layer_norm_eps=TINY.layer_norm_eps, hidden_act="gelu",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    return HFModel(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    return _hf_bert()
+
+
+@pytest.fixture(scope="module")
+def params(hf_model):
+    return params_from_hf_bert(hf_model.state_dict(), TINY)
+
+
+def test_logits_match_hf(hf_model, params):
+    import torch
+
+    model = BertForPreTraining(TINY)
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, TINY.vocab_size, size=(2, 20))
+    tok = rng.integers(0, 2, size=(2, 20))
+    mask = np.ones((2, 20), np.int32)
+    mask[0, 15:] = 0
+    mlm, nsp = model(
+        params, jnp.asarray(ids, jnp.int32), jnp.asarray(tok, jnp.int32),
+        jnp.asarray(mask, jnp.int32),
+    )
+    with torch.no_grad():
+        out = hf_model(
+            torch.tensor(ids), attention_mask=torch.tensor(mask),
+            token_type_ids=torch.tensor(tok),
+        )
+    np.testing.assert_allclose(
+        np.asarray(mlm, np.float32), out.prediction_logits.numpy(),
+        atol=2e-3, rtol=2e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(nsp, np.float32), out.seq_relationship_logits.numpy(),
+        atol=2e-4, rtol=2e-4,
+    )
+
+
+def test_pretraining_loss_matches_hf(hf_model, params):
+    import torch
+
+    model = BertForPreTraining(TINY)
+    rng = np.random.default_rng(6)
+    ids = rng.integers(0, TINY.vocab_size, size=(2, 16))
+    labels = np.full((2, 16), -100, np.int64)
+    labels[:, 3:7] = rng.integers(0, TINY.vocab_size, size=(2, 4))
+    nsl = np.array([0, 1])
+    batch = {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "labels": jnp.asarray(labels, jnp.int32),
+        "next_sentence_label": jnp.asarray(nsl, jnp.int32),
+    }
+    ours = float(model.pretraining_loss(params, batch))
+    with torch.no_grad():
+        out = hf_model(
+            torch.tensor(ids), labels=torch.tensor(labels),
+            next_sentence_label=torch.tensor(nsl),
+        )
+    np.testing.assert_allclose(ours, float(out.loss), atol=2e-4, rtol=2e-4)
+
+
+def test_tp_sharded_parity(params):
+    model = BertForPreTraining(TINY)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(0, TINY.vocab_size, (4, 32)),
+        jnp.int32,
+    )
+    want, want_nsp = model(params, ids)
+    want = np.asarray(want, np.float32)
+
+    parallel_state.destroy_model_parallel()
+    from neuronx_distributed_llama3_2_tpu.trainer import TrainingConfig
+
+    tc = TrainingConfig(tensor_parallel_size=2)
+    tc.initialize(devices=jax.devices()[:4])
+    try:
+        sharded = shard_pytree(params, model.specs())
+        got, got_nsp = model(sharded, ids)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), want, atol=2e-4, rtol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_nsp, np.float32), np.asarray(want_nsp, np.float32),
+            atol=2e-4, rtol=2e-4,
+        )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_mlm_train_step():
+    from neuronx_distributed_llama3_2_tpu.trainer import (
+        OptimizerConfig,
+        TrainingConfig,
+        initialize_parallel_model,
+        make_train_step,
+    )
+
+    parallel_state.destroy_model_parallel()
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, dtype=jnp.bfloat16)
+    tc = TrainingConfig(
+        tensor_parallel_size=2,
+        optimizer=OptimizerConfig(zero_one_enabled=True, warmup_steps=1),
+    )
+    tc.initialize(devices=jax.devices()[:4])
+    try:
+        model = BertForPreTraining(cfg)
+        state, _ = initialize_parallel_model(model, tc)
+        step = make_train_step(model, tc)
+        rng = np.random.default_rng(7)
+        ids = rng.integers(0, cfg.vocab_size, (4, 16))
+        labels = np.full((4, 16), -100, np.int64)
+        labels[:, 2:6] = rng.integers(0, cfg.vocab_size, (4, 4))
+        state, metrics = step(
+            state,
+            {
+                "input_ids": jnp.asarray(ids, jnp.int32),
+                "labels": jnp.asarray(labels, jnp.int32),
+            },
+        )
+        assert np.isfinite(float(metrics["loss"]))
+    finally:
+        parallel_state.destroy_model_parallel()
