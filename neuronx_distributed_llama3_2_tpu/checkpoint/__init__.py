@@ -5,6 +5,7 @@ from neuronx_distributed_llama3_2_tpu.checkpoint.storage import (  # noqa: F401
 )
 from neuronx_distributed_llama3_2_tpu.checkpoint.checkpoint import (  # noqa: F401
     CheckpointIOState,
+    copy_checkpoint,
     load_checkpoint,
     save_checkpoint,
     finalize_async_saves,

@@ -357,6 +357,66 @@ def _load_tree(
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def copy_checkpoint(
+    src_path: str,
+    src_tag: str,
+    dst_path: str,
+    dst_tag: Optional[str] = None,
+) -> str:
+    """Template-free offline copy of a complete tag between checkpoint roots
+    (fs ↔ S3, retagging) — every kind (model/optim/scheduler/user_content)
+    travels verbatim with manifests validated and the checkpoint/done marker
+    protocol replayed at the destination.
+
+    This is the offline half of the reference's conversion tooling
+    (optimizer/convert_zero_checkpoints.py:176) that survives the GSPMD
+    redesign: dp/tp/pp resharding itself needs NO offline tool here because
+    tensors are stored as *global* arrays — any parallel layout change
+    happens at load via specs (elastic resume). What remains is moving or
+    renaming checkpoints between storage roots without a template pytree.
+    Returns the destination tag."""
+    src = create_checkpoint_storage(src_path)
+    resolved = _resolve_tag(src, src_tag)
+    if resolved is None:
+        raise FileNotFoundError(
+            f"no checkpoint tag {src_tag!r} under {src.dirname()}"
+        )
+    dst_tag = dst_tag or resolved
+    dst = create_checkpoint_storage(dst_path)
+    dst.makedirs(dst_tag)
+    dst.unmark_done(dst_tag)
+    dst.mark_checkpoint(dst_tag)
+    copied = 0
+    for kind in ("model", "optim"):
+        mf_name = f"{resolved}/{kind}.manifest.json"
+        if not src.file_exists(mf_name):
+            continue
+        manifest = src.load_json(mf_name)
+        for key, entry in manifest.items():
+            if entry.get("none"):
+                continue
+            data = src.load_bytes(f"{resolved}/{entry['file']}")
+            arr = _from_npy(data)  # validates npy framing
+            if list(arr.shape) != list(entry["shape"]):
+                raise ValueError(
+                    f"corrupt checkpoint: {key} has shape {list(arr.shape)} "
+                    f"but manifest says {entry['shape']}"
+                )
+            dst.save_bytes(data, f"{dst_tag}/{entry['file']}")
+            copied += 1
+        dst.save_json(manifest, f"{dst_tag}/{kind}.manifest.json")
+    for extra in ("scheduler.json", "user_content.json", "meta.json"):
+        name = f"{resolved}/{extra}"
+        if src.file_exists(name):
+            dst.save_json(src.load_json(name), f"{dst_tag}/{extra}")
+    dst.mark_done(dst_tag)
+    logger.info(
+        "copied checkpoint %s/%s -> %s/%s (%d tensors)",
+        src.dirname(), resolved, dst.dirname(), dst_tag, copied,
+    )
+    return dst_tag
+
+
 def load_checkpoint(
     path: str,
     tag: str = "latest",

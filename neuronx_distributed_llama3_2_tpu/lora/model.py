@@ -77,7 +77,16 @@ def _split_shape(shape) -> Tuple[Tuple[int, ...], int, Tuple[int, ...]]:
     """(leading stack dims, in_features, out dims) of a kernel.
 
     Kernels here are (in, out...) possibly with a leading layer-stack dim:
-    (in, out), (L, in, out), (L, in, t, out) [fused gate_up]."""
+    (in, out) [incl. embeddings, reference LoraEmbedding layer.py:245],
+    (L, in, out), (L, in, t, out) [fused gate_up]. MoE expert weights carry
+    two stack dims (L, E, ...) the single-stack split below would misread —
+    LoraModel refuses expert paths at construction (the reference doesn't
+    LoRA experts either); the rank guard here backstops unknown layouts."""
+    if len(shape) > 4:
+        raise ValueError(
+            f"kernel rank {len(shape)} is not LoRA-targetable; exclude it "
+            "from target_modules"
+        )
     if len(shape) == 2:
         return (), shape[0], (shape[1],)
     return (shape[0],), shape[1], tuple(shape[2:])
@@ -96,6 +105,14 @@ class LoraModel:
         if not self._targets:
             raise ValueError(
                 f"no parameters match target_modules={config.target_modules}"
+            )
+        expert_hits = [p for p in self._targets if re.search(r"experts/", p)]
+        if expert_hits:
+            # (L, E, ...) carries two stack dims the single-stack shape split
+            # would silently misread as (stack=L, in=E) — refuse up front
+            raise ValueError(
+                f"MoE expert-fused weights are not LoRA-targetable (two "
+                f"stack dims): {expert_hits}; exclude them from target_modules"
             )
 
     @property

@@ -170,22 +170,32 @@ class BertLayer:
 
     def init(self, key: jax.Array) -> Params:
         kq, ko, ku, kd = jax.random.split(key, 4)
+        # nest under attn/ and mlp/ like every decoder family so path-regex
+        # tooling (quantization/LoRA DEFAULT_TARGETS) applies to BERT too
         return {
-            "qkv": self._qkv().init(kq),
-            "attn_out": self._attn_out().init(ko),
+            "attn": {
+                "qkv": self._qkv().init(kq),
+                "o": self._attn_out().init(ko),
+            },
             "attn_norm": self._norm().init(key),
-            "up": self._up().init(ku),
-            "down": self._down().init(kd),
+            "mlp": {
+                "up": self._up().init(ku),
+                "down": self._down().init(kd),
+            },
             "mlp_norm": self._norm().init(key),
         }
 
     def specs(self) -> Params:
         return {
-            "qkv": self._qkv().specs(),
-            "attn_out": self._attn_out().specs(),
+            "attn": {
+                "qkv": self._qkv().specs(),
+                "o": self._attn_out().specs(),
+            },
             "attn_norm": self._norm().specs(),
-            "up": self._up().specs(),
-            "down": self._down().specs(),
+            "mlp": {
+                "up": self._up().specs(),
+                "down": self._down().specs(),
+            },
             "mlp_norm": self._norm().specs(),
         }
 
@@ -194,18 +204,20 @@ class BertLayer:
     ) -> jax.Array:
         c = self.config
         b, s, _ = x.shape
-        q, k, v = self._qkv()(params["qkv"], x)
+        q, k, v = self._qkv()(params["attn"]["qkv"], x)
         q = q.reshape(b, s, c.num_heads, c.head_dim)
         k = k.reshape(b, s, c.num_heads, c.head_dim)
         v = v.reshape(b, s, c.num_heads, c.head_dim)
         att = core_attention(q, k, v, causal=False, bias=mask_bias)
         att = att.reshape(b, s, c.hidden_size)
         x = self._norm()(
-            params["attn_norm"], x + self._attn_out()(params["attn_out"], att)
+            params["attn_norm"], x + self._attn_out()(params["attn"]["o"], att)
         )
-        h = self._up()(params["up"], x)
+        h = self._up()(params["mlp"]["up"], x)
         h = jax.nn.gelu(h.astype(jnp.float32), approximate=False).astype(c.dtype)
-        return self._norm()(params["mlp_norm"], x + self._down()(params["down"], h))
+        return self._norm()(
+            params["mlp_norm"], x + self._down()(params["mlp"]["down"], h)
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -414,29 +426,33 @@ def params_from_hf_bert(state_dict: Dict[str, Any], config: BertConfig) -> Param
             },
         },
         "layers": {
-            "qkv": {
-                "q_kernel": st(pre + ".attention.self.query.weight", lambda w: w.T),
-                "k_kernel": st(pre + ".attention.self.key.weight", lambda w: w.T),
-                "v_kernel": st(pre + ".attention.self.value.weight", lambda w: w.T),
-                "q_bias": st(pre + ".attention.self.query.bias"),
-                "k_bias": st(pre + ".attention.self.key.bias"),
-                "v_bias": st(pre + ".attention.self.value.bias"),
-            },
-            "attn_out": {
-                "kernel": st(pre + ".attention.output.dense.weight", lambda w: w.T),
-                "bias": st(pre + ".attention.output.dense.bias"),
+            "attn": {
+                "qkv": {
+                    "q_kernel": st(pre + ".attention.self.query.weight", lambda w: w.T),
+                    "k_kernel": st(pre + ".attention.self.key.weight", lambda w: w.T),
+                    "v_kernel": st(pre + ".attention.self.value.weight", lambda w: w.T),
+                    "q_bias": st(pre + ".attention.self.query.bias"),
+                    "k_bias": st(pre + ".attention.self.key.bias"),
+                    "v_bias": st(pre + ".attention.self.value.bias"),
+                },
+                "o": {
+                    "kernel": st(pre + ".attention.output.dense.weight", lambda w: w.T),
+                    "bias": st(pre + ".attention.output.dense.bias"),
+                },
             },
             "attn_norm": {
                 "scale": st(pre + ".attention.output.LayerNorm.weight", dtype=f32),
                 "bias": st(pre + ".attention.output.LayerNorm.bias", dtype=f32),
             },
-            "up": {
-                "kernel": st(pre + ".intermediate.dense.weight", lambda w: w.T),
-                "bias": st(pre + ".intermediate.dense.bias"),
-            },
-            "down": {
-                "kernel": st(pre + ".output.dense.weight", lambda w: w.T),
-                "bias": st(pre + ".output.dense.bias"),
+            "mlp": {
+                "up": {
+                    "kernel": st(pre + ".intermediate.dense.weight", lambda w: w.T),
+                    "bias": st(pre + ".intermediate.dense.bias"),
+                },
+                "down": {
+                    "kernel": st(pre + ".output.dense.weight", lambda w: w.T),
+                    "bias": st(pre + ".output.dense.bias"),
+                },
             },
             "mlp_norm": {
                 "scale": st(pre + ".output.LayerNorm.weight", dtype=f32),

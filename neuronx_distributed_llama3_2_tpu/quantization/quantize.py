@@ -114,12 +114,31 @@ class QuantizedTensor:
         return (self.qvalue.astype(jnp.float32) * self.scale).astype(dtype)
 
 
+def _default_reduce_axes(ndim: int, config: QuantizationConfig) -> Tuple[int, ...]:
+    """Per-channel reduction = the contraction (input) axis only.
+
+    Every kernel in this codebase is laid out (...stack dims..., in, out)
+    with the contraction second-to-last; scales then vary over output
+    channels AND all stack dims — per-layer for (L, in, out) stacks, and
+    per-(layer, expert) for MoE (L, E, in, out) fused expert weights (the
+    reference's QuantizedExpertFusedColumn/RowParallel keep per-expert
+    scales the same way, quantization_layers.py:668,777). A non-default
+    ``per_channel_axis`` falls back to reducing every other axis."""
+    if config.per_channel_axis != -1:
+        axis = config.per_channel_axis % ndim
+        return tuple(i for i in range(ndim) if i != axis)
+    return (max(ndim - 2, 0),)
+
+
 def quantize_array(
-    w: jax.Array, config: QuantizationConfig = QuantizationConfig()
+    w: jax.Array,
+    config: QuantizationConfig = QuantizationConfig(),
+    reduce_axes: Optional[Tuple[int, ...]] = None,
 ) -> QuantizedTensor:
     """Symmetric absmax quantization (reference observer.py MinMaxObserver /
     PerChannelAbsMaxObserver → scale = absmax/qmax; quantize = round(w/scale)).
-    """
+    ``reduce_axes`` overrides which axes share a scale (per-channel mode);
+    fused gate_up tensors pass their off-position contraction axis."""
     wf = w.astype(jnp.float32)
     qdt = config.jax_dtype
     qmax = _qmax(qdt)
@@ -128,13 +147,8 @@ def quantize_array(
         scale = jnp.maximum(absmax / qmax, 1e-12)
         scale = scale.reshape((1,) * wf.ndim)
     else:
-        axis = config.per_channel_axis % wf.ndim
-        # kernels of rank >= 3 are layer-stacked (L, ..., out): keep a scale
-        # per (layer, channel) so depth-wise magnitude variation between
-        # layers doesn't let one layer's absmax wash out another's precision
-        # (the reference quantizes per-layer modules, so it gets this free)
-        keep = {axis} | ({0} if wf.ndim >= 3 else set())
-        reduce_axes = tuple(i for i in range(wf.ndim) if i not in keep)
+        if reduce_axes is None:
+            reduce_axes = _default_reduce_axes(wf.ndim, config)
         absmax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
         scale = jnp.maximum(absmax / qmax, 1e-12)
     q = wf / scale
@@ -145,36 +159,52 @@ def quantize_array(
     return QuantizedTensor(q.astype(qdt), scale)
 
 
-def scale_spec(kernel_spec: P, config: QuantizationConfig, ndim: int) -> P:
-    """PartitionSpec for a scale given its kernel's spec: keep the channel
-    axis's sharding, collapse every reduced axis to None (scales are size-1
-    there). Per-tensor scales are replicated."""
+def scale_spec(
+    kernel_spec: P,
+    config: QuantizationConfig,
+    ndim: int,
+    reduce_axes: Optional[Tuple[int, ...]] = None,
+) -> P:
+    """PartitionSpec for a scale given its kernel's spec: keep each
+    non-reduced axis's sharding, collapse reduced axes to None (scales are
+    size-1 there). Per-tensor scales are replicated."""
     if config.quantization_type is QuantizationType.PER_TENSOR_SYMMETRIC:
         return P(*((None,) * ndim))
-    axis = config.per_channel_axis % ndim
-    keep = {axis} | ({0} if ndim >= 3 else set())  # mirror quantize_array
+    if reduce_axes is None:
+        reduce_axes = _default_reduce_axes(ndim, config)
     entries = list(kernel_spec) + [None] * (ndim - len(list(kernel_spec)))
-    return P(*[entries[i] if i in keep else None for i in range(ndim)])
+    return P(*[None if i in reduce_axes else entries[i] for i in range(ndim)])
 
 
 # ---------------------------------------------------------------------------
 # pytree-level convert (reference quantize.convert, quantize.py:13)
 # ---------------------------------------------------------------------------
 
-#: kernels quantized by default: attention + MLP projection matrices.
-#: Embedding/norm/bias stay float (reference default mapping quantizes only
-#: the parallel linear layers, quantization_mappings.py).
+#: kernels quantized by default: attention + MLP projection matrices,
+#: including the 3D/4D fused MoE expert weights (reference
+#: QuantizedExpertFusedColumnParallel/RowParallel, quantization_layers.py:
+#: 668,777). Embedding/norm/bias stay float (reference default mapping
+#: quantizes only the parallel linear layers, quantization_mappings.py).
 DEFAULT_TARGETS = (
     r"attn/qkv/(q|k|v)_kernel$",
     r"attn/o/kernel$",
     r"mlp/gate_up$",
-    r"mlp/down/kernel$",
-    r"experts/.*kernel$",
+    r"mlp/(up|down)/kernel$",
+    r"experts/gate_up$",
+    r"experts/down$",
 )
 
 
 def _match(path_key: str, patterns) -> bool:
     return any(re.search(p, path_key) for p in patterns)
+
+
+def _reduce_axes_for(path: str, ndim: int) -> Optional[Tuple[int, ...]]:
+    """Fused gate_up tensors (..., in, 2, out) carry their contraction axis
+    third-from-last; everything else uses the (..., in, out) default."""
+    if path.endswith("gate_up") and ndim >= 3:
+        return (ndim - 3,)
+    return None
 
 
 def _walk(tree: Any, fn, path: str = "") -> Any:
@@ -196,7 +226,9 @@ def quantize_params(
 
     def visit(path, leaf):
         if isinstance(leaf, jax.Array) and leaf.ndim >= 2 and _match(path, targets):
-            return quantize_array(leaf, config)
+            return quantize_array(
+                leaf, config, reduce_axes=_reduce_axes_for(path, leaf.ndim)
+            )
         return leaf
 
     return _walk(params, visit)
@@ -217,7 +249,13 @@ def quantize_specs(
     def visit(path, spec):
         leaf = flat_p.get(path)
         if leaf is not None and getattr(leaf, "ndim", 0) >= 2 and _match(path, targets):
-            return QuantizedTensor(spec, scale_spec(spec, config, leaf.ndim))
+            return QuantizedTensor(
+                spec,
+                scale_spec(
+                    spec, config, leaf.ndim,
+                    reduce_axes=_reduce_axes_for(path, leaf.ndim),
+                ),
+            )
         return spec
 
     return _walk(specs, visit)

@@ -152,54 +152,103 @@ def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
         json.dump(cfg, f, indent=2)
 
 
+def _model_registry() -> Dict[str, Dict[str, Any]]:
+    """name → {config, model_cls, from_hf, to_hf?} across every model family
+    (the reference's per-family converter table, checkpoint_converter.py:33)."""
+    from neuronx_distributed_llama3_2_tpu import models as m
+
+    reg: Dict[str, Dict[str, Any]] = {}
+    for name, cfg in m.LLAMA_CONFIGS.items():
+        from neuronx_distributed_llama3_2_tpu.models.llama import (
+            params_from_hf,
+            params_to_hf,
+        )
+
+        reg[name] = {
+            "config": cfg, "model_cls": m.LlamaForCausalLM,
+            "from_hf": params_from_hf, "to_hf": params_to_hf,
+        }
+    for name, cfg in m.MIXTRAL_CONFIGS.items():
+        reg[name] = {
+            "config": cfg, "model_cls": m.MixtralForCausalLM,
+            "from_hf": m.params_from_hf_mixtral, "to_hf": None,
+        }
+    for name, cfg in m.DBRX_CONFIGS.items():
+        reg[name] = {
+            "config": cfg, "model_cls": m.DbrxForCausalLM,
+            "from_hf": m.params_from_hf_dbrx, "to_hf": None,
+        }
+    for name, cfg in m.GPTNEOX_CONFIGS.items():
+        from_hf = (
+            m.params_from_hf_codegen if cfg.rotary_interleaved
+            else m.params_from_hf_neox
+        )
+        reg[name] = {
+            "config": cfg, "model_cls": m.GPTNeoXForCausalLM,
+            "from_hf": from_hf, "to_hf": None,
+        }
+    for name, cfg in m.BERT_CONFIGS.items():
+        reg[name] = {
+            "config": cfg, "model_cls": m.BertForPreTraining,
+            "from_hf": m.params_from_hf_bert, "to_hf": None,
+        }
+    return reg
+
+
+def _resolve_model(name: str) -> Dict[str, Any]:
+    reg = _model_registry()
+    if name not in reg:
+        raise KeyError(
+            f"unknown model {name!r}; known: {', '.join(sorted(reg))}"
+        )
+    return reg[name]
+
+
 def hf_to_native(args) -> None:
     from neuronx_distributed_llama3_2_tpu.checkpoint import save_checkpoint
-    from neuronx_distributed_llama3_2_tpu.models.llama import (
-        LLAMA_CONFIGS,
-        params_from_hf,
-    )
 
-    config = LLAMA_CONFIGS[args.model]
+    entry = _resolve_model(args.model)
     sd = load_hf_state_dict(args.input)
-    params = params_from_hf(sd, config)
+    params = entry["from_hf"](sd, entry["config"])
     save_checkpoint(args.output, tag=args.tag, model=params)
     logger.info("wrote native checkpoint %s/%s", args.output, args.tag)
 
 
 def native_to_hf(args) -> None:
-    from neuronx_distributed_llama3_2_tpu.checkpoint import load_checkpoint
-    from neuronx_distributed_llama3_2_tpu.models.llama import (
-        LLAMA_CONFIGS,
-        LlamaForCausalLM,
-        params_to_hf,
-    )
-
     import jax
 
-    config = LLAMA_CONFIGS[args.model]
-    template = jax.eval_shape(LlamaForCausalLM(config).init, jax.random.key(0))
+    from neuronx_distributed_llama3_2_tpu.checkpoint import load_checkpoint
+
+    entry = _resolve_model(args.model)
+    if entry["to_hf"] is None:
+        raise NotImplementedError(
+            f"native→HF export is implemented for the Llama family only; "
+            f"{args.model!r} has no to_hf converter yet"
+        )
+    config = entry["config"]
+    template = jax.eval_shape(
+        entry["model_cls"](config).init, jax.random.key(0)
+    )
     loaded = load_checkpoint(args.input, tag=args.tag, model=template)
     if loaded is None:
         raise FileNotFoundError(f"no checkpoint tag {args.tag} under {args.input}")
-    sd = params_to_hf(loaded["model"], config)
+    sd = entry["to_hf"](loaded["model"], config)
     save_hf_state_dict(sd, args.output, config)
     logger.info("wrote HF checkpoint to %s", args.output)
 
 
 def strip_optimizer(args) -> None:
+    import jax
+
     from neuronx_distributed_llama3_2_tpu.checkpoint import (
         load_checkpoint,
         save_checkpoint,
     )
-    from neuronx_distributed_llama3_2_tpu.models.llama import (
-        LLAMA_CONFIGS,
-        LlamaForCausalLM,
+
+    entry = _resolve_model(args.model)
+    template = jax.eval_shape(
+        entry["model_cls"](entry["config"]).init, jax.random.key(0)
     )
-
-    import jax
-
-    config = LLAMA_CONFIGS[args.model]
-    template = jax.eval_shape(LlamaForCausalLM(config).init, jax.random.key(0))
     loaded = load_checkpoint(args.input, tag=args.tag, model=template)
     if loaded is None:
         raise FileNotFoundError(f"no checkpoint tag {args.tag} under {args.input}")
@@ -211,23 +260,43 @@ def strip_optimizer(args) -> None:
     )
 
 
+def copy_tag(args) -> None:
+    """Offline tag copy/retag between checkpoint roots (fs ↔ S3), optimizer
+    state included, no template needed. What remains of the reference's
+    nxd_convert_zero_checkpoints CLI under GSPMD: dp/tp/pp resharding needs
+    no offline step (global arrays reshard at load via specs), so the tool
+    moves storage location and tag name."""
+    from neuronx_distributed_llama3_2_tpu.checkpoint import copy_checkpoint
+
+    out = copy_checkpoint(args.input, args.tag, args.output, args.out_tag)
+    logger.info("copied to %s/%s", args.output, out)
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument(
         "--direction",
         required=True,
-        choices=["hf-to-native", "native-to-hf", "strip-optimizer"],
+        choices=["hf-to-native", "native-to-hf", "strip-optimizer", "copy-tag"],
     )
-    p.add_argument("--model", required=True, help="LLAMA_CONFIGS key")
+    p.add_argument(
+        "--model",
+        default=None,
+        help="model registry key (any family's *_CONFIGS name); "
+        "not needed for copy-tag",
+    )
     p.add_argument("--input", required=True)
     p.add_argument("--output", required=True)
     p.add_argument("--tag", default="latest", help="native checkpoint tag")
     p.add_argument("--out-tag", default=None)
     args = p.parse_args(argv)
+    if args.direction != "copy-tag" and args.model is None:
+        p.error(f"--model is required for --direction {args.direction}")
     {
         "hf-to-native": hf_to_native,
         "native-to-hf": native_to_hf,
         "strip-optimizer": strip_optimizer,
+        "copy-tag": copy_tag,
     }[args.direction](args)
 
 

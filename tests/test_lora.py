@@ -144,3 +144,65 @@ def test_custom_targets(base):
     assert gu["b"].shape == (TINY.num_layers, 2, 2, TINY.intermediate_size)
     with pytest.raises(ValueError, match="no parameters match"):
         LoraModel(model, params, LoraConfig(target_modules=(r"nonexistent",)))
+
+
+def test_embedding_target(base, batch):
+    """LoRA over the tied embedding (reference LoraEmbedding layer.py:245):
+    merged = base + BA on the (V, H) table, gradients flow, base-identical
+    at init."""
+    model, params = base
+    cfg = LoraConfig(r=4, target_modules=(r"embed/embedding$",))
+    lora = LoraModel(model, params, cfg)
+    adapters = lora.init(jax.random.key(1))
+    assert set(adapters) == {"embed/embedding"}
+    a = adapters["embed/embedding"]["a"]
+    b = adapters["embed/embedding"]["b"]
+    assert a.shape == (TINY.vocab_size, 4) and b.shape == (4, TINY.hidden_size)
+    # zero-init identity
+    np.testing.assert_allclose(
+        np.asarray(lora(adapters, batch), np.float32),
+        np.asarray(model(params, batch), np.float32),
+        atol=1e-6,
+    )
+    # gradient flows into the embedding adapter
+    grads = jax.grad(lora.loss)(adapters, batch, batch)
+    gnorm = float(
+        jnp.sum(jnp.abs(grads["embed/embedding"]["a"]))
+        + jnp.sum(jnp.abs(grads["embed/embedding"]["b"]))
+    )
+    assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_fused_gate_up_target(base, batch):
+    """LoRA over the fused (L, H, 2, I) gate_up kernel: B carries the fused
+    out dims (the role of the reference's fused-layer LoRA,
+    LoraGQAQKVParallelLinear tp_layer.py:66)."""
+    model, params = base
+    cfg = LoraConfig(r=2, target_modules=(r"mlp/gate_up$",))
+    lora = LoraModel(model, params, cfg)
+    adapters = lora.init(jax.random.key(2))
+    a = adapters["layers/mlp/gate_up"]["a"]
+    b = adapters["layers/mlp/gate_up"]["b"]
+    L, H, I = TINY.num_layers, TINY.hidden_size, TINY.intermediate_size
+    assert a.shape == (L, H, 2)  # (stack, in, r)
+    assert b.shape == (L, 2, 2, I)  # (stack, r, fused, out)
+    loss0 = float(lora.loss(adapters, batch, batch))
+    grads = jax.grad(lora.loss)(adapters, batch, batch)
+    stepped = jax.tree.map(lambda p, g: p - 0.5 * g, adapters, grads)
+    assert float(lora.loss(stepped, batch, batch)) < loss0
+
+
+def test_expert_weights_refused(base):
+    """5D MoE expert kernels are not LoRA-targetable; targeting them raises
+    instead of silently mis-splitting the shape."""
+    from neuronx_distributed_llama3_2_tpu.models import (
+        MIXTRAL_CONFIGS,
+        MixtralForCausalLM,
+    )
+
+    cfg = MIXTRAL_CONFIGS["tiny-moe"]
+    model = MixtralForCausalLM(cfg)
+    params = model.init(jax.random.key(3))
+    for target in (r"experts/gate_up$", r"experts/down$"):
+        with pytest.raises(ValueError, match="not LoRA-targetable"):
+            LoraModel(model, params, LoraConfig(r=2, target_modules=(target,)))
