@@ -56,6 +56,10 @@ def parse_args():
     p.add_argument("--keep-ckpts", type=int, default=3)
     p.add_argument("--metrics-file", default=None)
     p.add_argument(
+        "--tensorboard-dir", default=None,
+        help="write TensorBoard scalar events (loss/grad_norm/lr/seq_s)",
+    )
+    p.add_argument(
         "--native-loader", action="store_true",
         help="use the C++ mmap+prefetch token loader (native/token_loader.cc)",
     )
@@ -66,6 +70,10 @@ def parse_args():
     p.add_argument(
         "--profile-dir", default=None,
         help="capture an XLA device trace of steps 2-4 into this dir",
+    )
+    p.add_argument(
+        "--capacity-factor", type=float, default=None,
+        help="MoE capacity factor (required for --ep > 1 on MoE models)",
     )
     p.add_argument("--seed", type=int, default=42)
     p.add_argument(
@@ -96,10 +104,7 @@ def main():
         batch_to_device,
         write_token_file,
     )
-    from neuronx_distributed_llama3_2_tpu.models import (
-        LLAMA_CONFIGS,
-        LlamaForCausalLM,
-    )
+    from neuronx_distributed_llama3_2_tpu.models import resolve_model
     from neuronx_distributed_llama3_2_tpu.pipeline import PipelinedCausalLM
     from neuronx_distributed_llama3_2_tpu.trainer import (
         OptimizerConfig,
@@ -120,11 +125,29 @@ def main():
 
     logger = get_logger()
 
-    model_cfg = dataclasses.replace(
-        LLAMA_CONFIGS[args.model], max_seq_len=max(
-            args.seq_len, LLAMA_CONFIGS[args.model].max_seq_len
+    # any family's *_CONFIGS key works (llama / mixtral / dbrx / gpt-neox /
+    # codegen / bert — the reference ships one pretrain script per family;
+    # here one script serves the whole registry)
+    entry = resolve_model(args.model)
+    model_cfg = entry["config"]
+    is_bert = not hasattr(model_cfg, "max_seq_len")
+    if is_bert:
+        # BERT: fixed learned position table + MLM objective (masking below)
+        if args.seq_len > model_cfg.max_position_embeddings:
+            raise SystemExit(
+                f"--seq-len {args.seq_len} exceeds {args.model}'s learned "
+                f"position table ({model_cfg.max_position_embeddings})"
+            )
+    else:
+        model_cfg = dataclasses.replace(
+            model_cfg, max_seq_len=max(args.seq_len, model_cfg.max_seq_len)
         )
-    )
+    if args.capacity_factor is not None:
+        if not hasattr(model_cfg, "capacity_factor"):
+            raise SystemExit(f"--capacity-factor: {args.model} is not a MoE model")
+        model_cfg = dataclasses.replace(
+            model_cfg, capacity_factor=args.capacity_factor
+        )
     config = TrainingConfig(
         tensor_parallel_size=args.tp,
         pipeline_parallel_size=args.pp,
@@ -142,7 +165,7 @@ def main():
     )
     config.initialize()
 
-    base_model = LlamaForCausalLM(model_cfg)
+    base_model = entry["model_cls"](model_cfg)
     pipelined = args.pp > 1
     model = (
         PipelinedCausalLM(base_model, num_microbatches=max(args.microbatches, args.pp))
@@ -237,6 +260,11 @@ def main():
         )
 
     # -- train loop (reference tp_zero1_llama_hf_pretrain.py:277-350) -----
+    tb = None
+    if args.tensorboard_dir:
+        from neuronx_distributed_llama3_2_tpu.trainer import TensorBoardLogger
+
+        tb = TensorBoardLogger(args.tensorboard_dir)
     metrics_file = (
         TrainingMetrics(args.metrics_file) if args.metrics_file else None
     )
@@ -280,10 +308,25 @@ def main():
             profile_ctx.__enter__()
         with timeline.event("load_batch", cat="data"):
             batch = next(batches)
-            ids = batch_to_device(batch, mesh)
+            if is_bert:
+                # MLM objective: mask 15% of positions; only those carry
+                # labels (causal next-token labels would make BERT's
+                # bidirectional encoder solve a trivial copy task).
+                # [MASK] surrogate = vocab_size - 1 on synthetic streams.
+                mask_rng = np.random.default_rng(args.seed * 100003 + step)
+                masked = np.array(batch)
+                labels = np.full_like(masked, -100)
+                pick = mask_rng.random(masked.shape) < 0.15
+                labels[pick] = masked[pick]
+                masked[pick] = model_cfg.vocab_size - 1
+                ids = batch_to_device(masked, mesh)
+                lbl = batch_to_device(labels, mesh)
+            else:
+                ids = batch_to_device(batch, mesh)
+                lbl = ids
         t0 = time.perf_counter()
         with timeline.event("train_step", cat="step"), step_annotation(step):
-            state, m = step_fn(state, {"input_ids": ids, "labels": ids})
+            state, m = step_fn(state, {"input_ids": ids, "labels": lbl})
             loss = float(m["loss"])  # blocks until the step finished
         if args.profile_dir and step == start_step + 4:
             stop_profile()
@@ -302,6 +345,16 @@ def main():
                 lr=float(m["learning_rate"]),
                 seqs_per_s=seqs_per_s,
             )
+        if tb:
+            tb.log_scalars(
+                step,
+                {
+                    "train/loss": loss,
+                    "train/grad_norm": float(m["grad_norm"]),
+                    "train/lr": float(m["learning_rate"]),
+                    **({"train/seqs_per_s": seqs_per_s} if seqs_per_s else {}),
+                },
+            )
         if (step + 1) % args.save_every == 0 and step + 1 < args.steps:
             with timeline.event("save_checkpoint", cat="ckpt", step=step + 1):
                 save(step + 1)
@@ -311,6 +364,8 @@ def main():
     if start_step < args.steps:
         save(args.steps)
     timeline.close()
+    if tb:
+        tb.close()
     from neuronx_distributed_llama3_2_tpu.checkpoint import (
         finalize_async_saves,
     )

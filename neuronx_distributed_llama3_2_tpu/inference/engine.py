@@ -148,16 +148,22 @@ class InferenceEngine:
         self._programs[key_] = fn
         return fn
 
-    def _decode_program(self, batch: int, cfg: SamplingConfig):
-        """Token-gen program: T=1 forward + on-device sample."""
-        key_ = ("decode", batch, cfg)
+    def _decode_program(
+        self, batch: int, cfg: SamplingConfig, kv_limit: Optional[int] = None
+    ):
+        """Token-gen program: T=1 forward + on-device sample. ``kv_limit``
+        is the token-gen cache bucket (reference autobucketing.py:31-56:
+        bucket picked from position) — attention reads only that many cache
+        rows; one program is compiled per bucket in use."""
+        key_ = ("decode", batch, cfg, kv_limit)
         if key_ in self._programs:
             return self._programs[key_]
         model = self.model
 
         def decode(params, cache, tokens, positions, slots, key):
             logits, cache = model.forward(
-                params, cache, tokens[:, None], positions, slots
+                params, cache, tokens[:, None], positions, slots,
+                kv_limit=kv_limit,
             )
             logits = logits[:, 0, :]
             nxt = sample(logits, key, cfg)
@@ -167,11 +173,18 @@ class InferenceEngine:
         self._programs[key_] = fn
         return fn
 
-    def _decode_multi_program(self, batch: int, cfg: SamplingConfig, steps: int):
+    def _decode_multi_program(
+        self,
+        batch: int,
+        cfg: SamplingConfig,
+        steps: int,
+        kv_limit: Optional[int] = None,
+    ):
         """Token-gen program emitting ``steps`` tokens in one executable:
         lax.scan of (forward T=1 → on-device sample), cache donated through
-        the carry. One host round-trip per ``steps`` tokens."""
-        key_ = ("decode_multi", batch, cfg, steps)
+        the carry. One host round-trip per ``steps`` tokens. ``kv_limit``
+        must cover position + steps for every request in the chunk."""
+        key_ = ("decode_multi", batch, cfg, steps, kv_limit)
         if key_ in self._programs:
             return self._programs[key_]
         model = self.model
@@ -184,7 +197,8 @@ class InferenceEngine:
                 cache, toks, pos, key = carry
                 key, kd = jax.random.split(key)
                 logits, cache = model.forward(
-                    params, cache, toks[:, None], pos, slots
+                    params, cache, toks[:, None], pos, slots,
+                    kv_limit=kv_limit,
                 )
                 nxt = sample(logits[:, 0, :], kd, cfg)
                 return (cache, nxt, pos + 1, key), nxt
@@ -246,15 +260,18 @@ class InferenceEngine:
                     params_abs, cache_abs, i32(b, bucket), i32(b), i32(b),
                     key_abs,
                 ).compile()
-            fn = self._decode_program(b, sampling)
-            self._programs[("decode", b, sampling)] = fn.lower(
-                params_abs, cache_abs, i32(b), i32(b), i32(b), key_abs
-            ).compile()
-            for steps in on_device_steps:
-                fn = self._decode_multi_program(b, sampling, steps)
-                self._programs[("decode_multi", b, sampling, steps)] = fn.lower(
+                # token-gen programs are per-kv-bucket too (autobucketing)
+                fn = self._decode_program(b, sampling, bucket)
+                self._programs[("decode", b, sampling, bucket)] = fn.lower(
                     params_abs, cache_abs, i32(b), i32(b), i32(b), key_abs
                 ).compile()
+                for steps in on_device_steps:
+                    fn = self._decode_multi_program(b, sampling, steps, bucket)
+                    self._programs[
+                        ("decode_multi", b, sampling, steps, bucket)
+                    ] = fn.lower(
+                        params_abs, cache_abs, i32(b), i32(b), i32(b), key_abs
+                    ).compile()
             for block in speculative_blocks:
                 fn = self._verify_program(b, block)
                 self._programs[("verify", b, block)] = fn.lower(
@@ -327,7 +344,6 @@ class InferenceEngine:
 
         bench = GenerationBenchmark()
         key = jax.random.key(gen.seed)
-        decode = self._decode_program(b, gen.sampling)
 
         t_start = time.perf_counter()
         key, k0 = jax.random.split(key)
@@ -344,17 +360,21 @@ class InferenceEngine:
 
         remaining = gen.max_new_tokens - 1
         steps = max(1, gen.on_device_steps)
-        decode_multi = (
-            self._decode_multi_program(b, gen.sampling, steps)
-            if steps > 1
-            else None
-        )
+        pos_max = int(lengths.max())  # host mirror of the write frontier
         while remaining > 0 and not all(done):
             # the multi-step program has a fixed shape: use it for full
             # chunks; single-step for the tail. (The entry guard already
             # bounds max_len + max_new_tokens by max_seq_len, so a full
-            # chunk always fits the cache.)
-            if decode_multi is not None and steps <= remaining:
+            # chunk always fits the cache.) The kv bucket covers the chunk's
+            # final write position (token-gen autobucketing).
+            use_multi = steps > 1 and steps <= remaining
+            kv_limit = pick_bucket(
+                self.buckets, pos_max + (steps if use_multi else 1)
+            )
+            if use_multi:
+                decode_multi = self._decode_multi_program(
+                    b, gen.sampling, steps, kv_limit
+                )
                 t0 = time.perf_counter()
                 toks_block, tokens, key, self.cache = decode_multi(
                     self.params, self.cache, tokens, positions, slots, key
@@ -366,6 +386,7 @@ class InferenceEngine:
                 positions = positions + steps
                 emitted = steps
             else:
+                decode = self._decode_program(b, gen.sampling, kv_limit)
                 key, kd = jax.random.split(key)
                 with bench.per_token.timed():
                     tokens, _, self.cache = decode(
@@ -375,6 +396,7 @@ class InferenceEngine:
                 block_host = tokens_host[None, :]
                 positions = positions + 1
                 emitted = 1
+            pos_max += emitted
             remaining -= emitted
             for t in range(emitted):
                 for i in range(nreq):
@@ -506,7 +528,14 @@ class ContinuousBatchingEngine:
             return bool(self._queue)
         eng = self.engine
         b = eng.max_batch
-        decode = eng._decode_program(b, self.gen.sampling)
+        # token-gen kv bucket must cover the furthest active slot's write
+        # position (idle slots hold stale positions but their reads are
+        # discarded, and writes land at their stale rows inside the bucket)
+        kv_limit = pick_bucket(
+            eng.buckets,
+            int(max(self._positions[s] for s in self._active)) + 1,
+        )
+        decode = eng._decode_program(b, self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)
         toks, _, eng.cache = decode(
             eng.params,

@@ -128,6 +128,7 @@ class LlamaDecode:
         context_encode: bool = False,
         return_hidden: bool = False,
         tree: Optional[Tuple[jax.Array, jax.Array]] = None,
+        kv_limit: Optional[int] = None,
     ) -> Tuple[jax.Array, KVCache]:
         """Block-causal forward over the cache.
 
@@ -136,6 +137,12 @@ class LlamaDecode:
         block (bucket-causal, no cache read) — the fast prefill path; the
         general path attends over the whole cache with the mask
         ``j <= position + t``.
+
+        ``kv_limit`` (static) bounds the cache rows read by attention to the
+        first ``kv_limit`` — the token-gen bucket of the reference's
+        autobucketing (:31-56: pick bucket from position), cutting cache
+        read traffic from S_max to the bucket while writes still land in the
+        full cache. Caller guarantees ``position + T <= kv_limit``.
 
         ``tree``: Medusa-style tree verification — a pair
         ``(depths (T,) int32, ancestor_mask (T, T) bool)``. The fresh block
@@ -172,7 +179,7 @@ class LlamaDecode:
             lp, kc, vc = layer_in
             x, kc, vc = self._decode_layer(
                 lp, x, kc, vc, sin, cos, pos_block, positions, slots,
-                context_encode=context_encode, tree=tree,
+                context_encode=context_encode, tree=tree, kv_limit=kv_limit,
             )
             return x, (kc, vc)
 
@@ -198,7 +205,7 @@ class LlamaDecode:
 
     def _decode_layer(
         self, lp, x, kc, vc, sin, cos, pos_block, positions, slots,
-        *, context_encode: bool, tree=None,
+        *, context_encode: bool, tree=None, kv_limit=None,
     ):
         """One decoder layer with cache read/write.
 
@@ -251,9 +258,13 @@ class LlamaDecode:
 
             att = core_attention(q, k, v, causal=True)
         else:
-            # attend over the cache rows of the active slots
-            k_all = jnp.take(kc, slots, axis=0).astype(q.dtype)  # (b,S_max,NKV,D)
-            v_all = jnp.take(vc, slots, axis=0).astype(q.dtype)
+            # attend over the cache rows of the active slots, bounded to the
+            # token-gen bucket when given (static slice — reads only
+            # kv_limit rows from HBM instead of the whole S_max cache)
+            kr = kc if kv_limit is None else kc[:, :kv_limit]
+            vr = vc if kv_limit is None else vc[:, :kv_limit]
+            k_all = jnp.take(kr, slots, axis=0).astype(q.dtype)  # (b,S≤max,NKV,D)
+            v_all = jnp.take(vr, slots, axis=0).astype(q.dtype)
             att = self._cache_attention(
                 q, k_all, v_all, pos_block, ha, positions=positions, tree=tree
             )

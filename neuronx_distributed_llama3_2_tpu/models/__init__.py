@@ -35,3 +35,54 @@ from neuronx_distributed_llama3_2_tpu.models.mllama import (  # noqa: F401
     MllamaVisionConfig,
     mllama_params_from_hf,
 )
+from neuronx_distributed_llama3_2_tpu.models.llama import (  # noqa: F401
+    params_from_hf,
+    params_to_hf,
+)
+
+
+def model_registry():
+    """name → {config, model_cls, from_hf, to_hf} across every family
+    (the reference's per-family converter table,
+    scripts/checkpoint_converter.py:33). Shared by the converter CLI and the
+    pretrain example."""
+    reg = {}
+    for name, cfg in LLAMA_CONFIGS.items():
+        reg[name] = {
+            "config": cfg, "model_cls": LlamaForCausalLM,
+            "from_hf": params_from_hf, "to_hf": params_to_hf,
+        }
+    for name, cfg in MIXTRAL_CONFIGS.items():
+        reg[name] = {
+            "config": cfg, "model_cls": MixtralForCausalLM,
+            "from_hf": params_from_hf_mixtral, "to_hf": None,
+        }
+    for name, cfg in DBRX_CONFIGS.items():
+        reg[name] = {
+            "config": cfg, "model_cls": DbrxForCausalLM,
+            "from_hf": params_from_hf_dbrx, "to_hf": None,
+        }
+    for name, cfg in GPTNEOX_CONFIGS.items():
+        reg[name] = {
+            "config": cfg, "model_cls": GPTNeoXForCausalLM,
+            "from_hf": (
+                params_from_hf_codegen if cfg.rotary_interleaved
+                else params_from_hf_neox
+            ),
+            "to_hf": None,
+        }
+    for name, cfg in BERT_CONFIGS.items():
+        reg[name] = {
+            "config": cfg, "model_cls": BertForPreTraining,
+            "from_hf": params_from_hf_bert, "to_hf": None,
+        }
+    return reg
+
+
+def resolve_model(name: str):
+    reg = model_registry()
+    if name not in reg:
+        raise KeyError(
+            f"unknown model {name!r}; known: {', '.join(sorted(reg))}"
+        )
+    return reg[name]

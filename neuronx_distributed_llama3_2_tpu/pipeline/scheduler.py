@@ -184,9 +184,21 @@ class TrainInterleavedSchedule(PipeSchedule):
     chunk/microbatch assignment math mirrors scheduler.py:319-353 —
     warmup = 2·(pp - rank - 1) + (chunks - 1)·pp steps (:303-309, capped at
     total), steady-state 1F1B over (step → chunk, microbatch) with backward
-    running ``warmup`` steps late. The SPMD executors realize the gpipe and
-    1f1b schedules today; the VPP timing is specified and oracle-tested here
-    for the (pp·chunks)-stage executor extension.
+    running ``warmup`` steps late.
+
+    **Why no SPMD executor realizes this schedule** (deliberate, not a gap):
+    interleaving pays off on MPMD runtimes because a rank idling during
+    fill/drain costs nothing, so splitting its stage into ``chunks`` shorter
+    virtual stages shrinks warmup wall-clock by ~chunks×. The SPMD rotation
+    executors (pipeline/model.py) run every lane every rotation — fill/drain
+    lanes compute on masked garbage at full cost — so chunking a lane's work
+    only multiplies the number of fill rotations by ``chunks`` while dividing
+    each one's length by the same factor: the bubble *time* is unchanged at
+    best, and the extra collective-permutes make it worse. On TPU the levers
+    that actually cut the bubble are more microbatches (M ≥ 4·pp) and the
+    1F1B executor's O(pp) activation bound; the schedule stays here,
+    oracle-tested, as the spec for a future MPMD-style multi-controller
+    executor where per-lane idling is real.
     """
 
     def __init__(

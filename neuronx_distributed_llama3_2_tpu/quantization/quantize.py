@@ -123,10 +123,13 @@ def _default_reduce_axes(ndim: int, config: QuantizationConfig) -> Tuple[int, ..
     per-(layer, expert) for MoE (L, E, in, out) fused expert weights (the
     reference's QuantizedExpertFusedColumn/RowParallel keep per-expert
     scales the same way, quantization_layers.py:668,777). A non-default
-    ``per_channel_axis`` falls back to reducing every other axis."""
+    ``per_channel_axis`` keeps that axis plus the layer-stack axis (the
+    pre-reduce-axes semantics, so axis=2 and axis=-1 agree on (L, in, out)
+    stacks instead of silently dropping the per-layer scales)."""
     if config.per_channel_axis != -1:
         axis = config.per_channel_axis % ndim
-        return tuple(i for i in range(ndim) if i != axis)
+        keep = {axis} | ({0} if ndim >= 3 else set())
+        return tuple(i for i in range(ndim) if i not in keep)
     return (max(ndim - 2, 0),)
 
 

@@ -152,56 +152,12 @@ def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
         json.dump(cfg, f, indent=2)
 
 
-def _model_registry() -> Dict[str, Dict[str, Any]]:
-    """name → {config, model_cls, from_hf, to_hf?} across every model family
-    (the reference's per-family converter table, checkpoint_converter.py:33)."""
-    from neuronx_distributed_llama3_2_tpu import models as m
-
-    reg: Dict[str, Dict[str, Any]] = {}
-    for name, cfg in m.LLAMA_CONFIGS.items():
-        from neuronx_distributed_llama3_2_tpu.models.llama import (
-            params_from_hf,
-            params_to_hf,
-        )
-
-        reg[name] = {
-            "config": cfg, "model_cls": m.LlamaForCausalLM,
-            "from_hf": params_from_hf, "to_hf": params_to_hf,
-        }
-    for name, cfg in m.MIXTRAL_CONFIGS.items():
-        reg[name] = {
-            "config": cfg, "model_cls": m.MixtralForCausalLM,
-            "from_hf": m.params_from_hf_mixtral, "to_hf": None,
-        }
-    for name, cfg in m.DBRX_CONFIGS.items():
-        reg[name] = {
-            "config": cfg, "model_cls": m.DbrxForCausalLM,
-            "from_hf": m.params_from_hf_dbrx, "to_hf": None,
-        }
-    for name, cfg in m.GPTNEOX_CONFIGS.items():
-        from_hf = (
-            m.params_from_hf_codegen if cfg.rotary_interleaved
-            else m.params_from_hf_neox
-        )
-        reg[name] = {
-            "config": cfg, "model_cls": m.GPTNeoXForCausalLM,
-            "from_hf": from_hf, "to_hf": None,
-        }
-    for name, cfg in m.BERT_CONFIGS.items():
-        reg[name] = {
-            "config": cfg, "model_cls": m.BertForPreTraining,
-            "from_hf": m.params_from_hf_bert, "to_hf": None,
-        }
-    return reg
-
-
 def _resolve_model(name: str) -> Dict[str, Any]:
-    reg = _model_registry()
-    if name not in reg:
-        raise KeyError(
-            f"unknown model {name!r}; known: {', '.join(sorted(reg))}"
-        )
-    return reg[name]
+    """Thin alias kept for CLI-internal use; the registry's public home is
+    :func:`neuronx_distributed_llama3_2_tpu.models.resolve_model`."""
+    from neuronx_distributed_llama3_2_tpu.models import resolve_model
+
+    return resolve_model(name)
 
 
 def hf_to_native(args) -> None:

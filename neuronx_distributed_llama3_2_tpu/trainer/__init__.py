@@ -8,6 +8,9 @@ from neuronx_distributed_llama3_2_tpu.trainer.optimizer import (  # noqa: F401
     optimizer_state_specs,
     apply_gradients,
 )
+from neuronx_distributed_llama3_2_tpu.trainer.tensorboard import (  # noqa: F401
+    TensorBoardLogger,
+)
 from neuronx_distributed_llama3_2_tpu.trainer.trainer import (  # noqa: F401
     TrainState,
     initialize_parallel_model,

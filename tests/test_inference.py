@@ -403,7 +403,8 @@ def test_on_device_steps_sampling_rng_parity():
     eng = InferenceEngine(cfg, params, max_batch=1, max_seq_len=64)
     sampling = SamplingConfig(greedy=False, temperature=1.0, top_k=8)
     eng.aot_compile(sampling=sampling, on_device_steps=(4,))
-    assert ("decode_multi", 1, sampling, 4) in eng._programs
+    # token-gen programs are keyed per kv bucket (64 is the only bucket here)
+    assert ("decode_multi", 1, sampling, 4, 64) in eng._programs
     prompts = [list(np.random.default_rng(2).integers(0, cfg.vocab_size, 6))]
     ref = eng.generate(
         prompts, GenerationConfig(max_new_tokens=13, sampling=sampling, seed=5)
@@ -415,3 +416,36 @@ def test_on_device_steps_sampling_rng_parity():
         ),
     ).sequences
     assert got == ref
+
+
+def test_decode_kv_bucket_parity(params, prompt):
+    """kv_limit (token-gen autobucketing, reference autobucketing.py:31-56)
+    reads only the bucket rows but must produce identical step logits."""
+    model = LlamaDecode(TINY)
+    cache = model.init_cache(1, 128)
+    ids = jnp.asarray([prompt], jnp.int32)
+    _, cache = model.forward(
+        params, cache, ids, jnp.zeros((1,), jnp.int32), context_encode=True
+    )
+    tok = jnp.asarray([[prompt[-1]]], jnp.int32)
+    pos = jnp.asarray([len(prompt)], jnp.int32)
+    full, _ = model.forward(params, cache, tok, pos)
+    for limit in (16, 32, 128):
+        bucketed, _ = model.forward(params, cache, tok, pos, kv_limit=limit)
+        np.testing.assert_allclose(
+            np.asarray(bucketed, np.float32), np.asarray(full, np.float32),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
+def test_generate_with_buckets_matches_single_bucket(params):
+    """The bucket-laddered engine emits the same greedy tokens as a
+    single-max-bucket engine (fp32: exact)."""
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, TINY.vocab_size, size=(10,)).tolist()]
+    g = GenerationConfig(max_new_tokens=8, sampling=SamplingConfig(greedy=True))
+    ladder = InferenceEngine(TINY, params, max_batch=1, max_seq_len=128)
+    single = InferenceEngine(
+        TINY, params, max_batch=1, max_seq_len=128, buckets=[128]
+    )
+    assert ladder.generate(prompts, g).sequences == single.generate(prompts, g).sequences

@@ -1,0 +1,67 @@
+"""TensorBoard event-writer tests (role of the reference's
+NeuronTensorBoardLogger, lightning/logger.py:24): TFRecord framing with
+masked crc32c, protobuf scalar encoding, crc-checked roundtrip."""
+
+import os
+import struct
+
+import numpy as np
+
+from neuronx_distributed_llama3_2_tpu.trainer.tensorboard import (
+    TensorBoardLogger,
+    _crc32c,
+    _masked_crc,
+    read_scalars,
+)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors
+    assert _crc32c(b"") == 0x0
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_writer_roundtrip(tmp_path):
+    logdir = tmp_path / "tb"
+    with TensorBoardLogger(str(logdir)) as tb:
+        for step in range(5):
+            tb.log_scalars(
+                step, {"train/loss": 5.0 - step * 0.5, "train/lr": 1e-4 * step}
+            )
+    files = os.listdir(logdir)
+    assert len(files) == 1 and files[0].startswith("events.out.tfevents.")
+    scalars = read_scalars(str(logdir / files[0]))
+    assert set(scalars) == {"train/loss", "train/lr"}
+    np.testing.assert_allclose(scalars["train/loss"][0], 5.0)
+    np.testing.assert_allclose(scalars["train/loss"][4], 3.0)
+    np.testing.assert_allclose(scalars["train/lr"][3], 3e-4, rtol=1e-6)
+
+
+def test_file_version_header(tmp_path):
+    logdir = tmp_path / "tb"
+    tb = TensorBoardLogger(str(logdir))
+    tb.close()
+    path = logdir / os.listdir(logdir)[0]
+    data = path.read_bytes()
+    (length,) = struct.unpack("<Q", data[:8])
+    payload = data[12 : 12 + length]
+    assert b"brain.Event:2" in payload
+    # framing crcs hold
+    assert struct.unpack("<I", data[8:12])[0] == _masked_crc(data[:8])
+
+
+def test_corruption_detected(tmp_path):
+    logdir = tmp_path / "tb"
+    with TensorBoardLogger(str(logdir)) as tb:
+        tb.log_scalars(1, {"x": 1.0})
+    path = logdir / os.listdir(logdir)[0]
+    raw = bytearray(path.read_bytes())
+    raw[-5] ^= 0xFF  # flip a byte inside the last record's payload
+    path.write_bytes(bytes(raw))
+    try:
+        read_scalars(str(path))
+    except ValueError as e:
+        assert "crc" in str(e)
+    else:
+        raise AssertionError("corruption not detected")
