@@ -118,7 +118,30 @@ class InferenceEngine:
         if self.buckets[-1] > max_seq_len:
             raise ValueError("largest bucket exceeds max_seq_len")
         self.cache = self.model.init_cache(max_batch, max_seq_len, cache_dtype)
+        # place the cache on the live mesh (kv heads over tp, batch over dp
+        # when divisible) so mesh-sharded params and cache agree — the
+        # engine-side analogue of StateInitializer's per-rank state alloc
+        from neuronx_distributed_llama3_2_tpu.parallel import (
+            state as parallel_state,
+        )
+
+        if parallel_state.model_parallel_is_initialized():
+            from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+                shard_pytree,
+            )
+
+            self.cache = shard_pytree(
+                self.cache, self.model.cache_specs(max_batch)
+            )
         self._programs: Dict[Tuple, Callable] = {}
+
+    def _kv_bucket(self, needed: int) -> int:
+        """Token-gen cache bucket covering ``needed`` rows; positions past a
+        short custom ladder fall back to the full cache (decode must keep
+        working to max_seq_len even when buckets top out below it)."""
+        if needed > self.buckets[-1]:
+            return self.max_seq_len
+        return pick_bucket(self.buckets, needed)
 
     # -- program table ----------------------------------------------------
 
@@ -368,9 +391,7 @@ class InferenceEngine:
             # chunk always fits the cache.) The kv bucket covers the chunk's
             # final write position (token-gen autobucketing).
             use_multi = steps > 1 and steps <= remaining
-            kv_limit = pick_bucket(
-                self.buckets, pos_max + (steps if use_multi else 1)
-            )
+            kv_limit = self._kv_bucket(pos_max + (steps if use_multi else 1))
             if use_multi:
                 decode_multi = self._decode_multi_program(
                     b, gen.sampling, steps, kv_limit
@@ -531,9 +552,8 @@ class ContinuousBatchingEngine:
         # token-gen kv bucket must cover the furthest active slot's write
         # position (idle slots hold stale positions but their reads are
         # discarded, and writes land at their stale rows inside the bucket)
-        kv_limit = pick_bucket(
-            eng.buckets,
-            int(max(self._positions[s] for s in self._active)) + 1,
+        kv_limit = eng._kv_bucket(
+            int(max(self._positions[s] for s in self._active)) + 1
         )
         decode = eng._decode_program(b, self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)

@@ -361,9 +361,15 @@ class MixtralDecode(LlamaDecode):
 def decode_model_for(config) -> LlamaDecode:
     """Pick the decode-model class for a training config (the engine-side
     analogue of the reference's per-family NeuronXxxForCausalLM dispatch)."""
+    from neuronx_distributed_llama3_2_tpu.models.bert import BertConfig
     from neuronx_distributed_llama3_2_tpu.models.gptneox import GPTNeoXConfig
     from neuronx_distributed_llama3_2_tpu.models.mixtral import MixtralConfig
 
+    if isinstance(config, BertConfig):
+        raise NotImplementedError(
+            "BERT is a bidirectional encoder — there is no KV-cache decode; "
+            "use BertForPreTraining's forward directly"
+        )
     if isinstance(config, GPTNeoXConfig):
         # parallel-residual blocks + partial rotary don't match the Llama
         # decode layer; refusing beats silently-wrong generation (the

@@ -149,6 +149,9 @@ class MixtralForCausalLM:
     def _logits(self, params: Params, hidden: jax.Array) -> jax.Array:
         return self._llama()._logits(params, hidden)
 
+    def _rope(self, s: int):
+        return self._llama()._rope(s)
+
     def init(self, key: jax.Array) -> Params:
         c = self.config
         ke, kl, kh = jax.random.split(key, 3)
@@ -186,7 +189,7 @@ class MixtralForCausalLM:
         c = self.config
         b, s = input_ids.shape
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-        sin, cos = precompute_rope(c.head_dim, s, c.rope_theta, c.rope_scaling)
+        sin, cos = self._rope(s)
         x = self._llama()._embed()(params["embed"], input_ids)
         if parallel_state.sequence_parallel_enabled():
             x = constrain(x, P(BATCH_AXES, TP_AXIS, None))

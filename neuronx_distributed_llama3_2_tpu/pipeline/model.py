@@ -44,7 +44,6 @@ from jax.sharding import PartitionSpec as P
 from neuronx_distributed_llama3_2_tpu.models.llama import (
     LlamaForCausalLM,
     _remat_policy,
-    precompute_rope,
 )
 from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
 from neuronx_distributed_llama3_2_tpu.parallel.layers import BATCH_AXES, constrain
@@ -199,9 +198,10 @@ class PipelinedCausalLM:
         mbs = gbs // M
 
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mbs, S))
-        sin, cos = precompute_rope(
-            cfg.head_dim, S, cfg.rope_theta, cfg.rope_scaling
-        )
+        # the model's own rope hook: partial-rotary families (GPT-NeoX/
+        # CodeGen) override _rope, and using cfg.head_dim here would feed
+        # them wrong tables
+        sin, cos = self.model._rope(S)
 
         x = self.model._embed()(params["embed"], input_ids)  # (GBS, S, H)
         # strided microbatch split (see trainer.make_train_step): microbatch
@@ -335,7 +335,7 @@ class PipelinedCausalLM:
         policy = _remat_policy(cfg.remat)
 
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mbs, S))
-        sin, cos = precompute_rope(cfg.head_dim, S, cfg.rope_theta, cfg.rope_scaling)
+        sin, cos = self.model._rope(S)
 
         # strided microbatch split (same convention as the gpipe path)
         ids_mb = input_ids.reshape(mbs, M, S).swapaxes(0, 1)  # (M, mbs, S)

@@ -147,3 +147,30 @@ def test_decode_refused():
 
     with pytest.raises(NotImplementedError):
         decode_model_for(TINY_NEOX)
+
+
+def test_pipelined_neox_matches_unpipelined():
+    """pp=2 GPipe on GPT-NeoX == unpipelined forward (guards the pipeline's
+    use of the model rope hook — head_dim tables would silently corrupt
+    partial rotary)."""
+    from neuronx_distributed_llama3_2_tpu.pipeline import PipelinedCausalLM
+
+    cfg = TINY_NEOX
+    model = GPTNeoXForCausalLM(cfg)
+    params = model.init(jax.random.key(3))
+    ids = jnp.asarray(
+        np.random.default_rng(4).integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+    )
+    want = float(model.loss(params, ids, ids))
+
+    parallel_state.destroy_model_parallel()
+    from neuronx_distributed_llama3_2_tpu.trainer import TrainingConfig
+
+    tc = TrainingConfig(pipeline_parallel_size=2)
+    tc.initialize(devices=jax.devices()[:4])
+    try:
+        pipe = PipelinedCausalLM(model, num_microbatches=2)
+        got = float(pipe.loss(pipe.to_pipeline(params), ids, ids))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    finally:
+        parallel_state.destroy_model_parallel()

@@ -449,3 +449,24 @@ def test_generate_with_buckets_matches_single_bucket(params):
         TINY, params, max_batch=1, max_seq_len=128, buckets=[128]
     )
     assert ladder.generate(prompts, g).sequences == single.generate(prompts, g).sequences
+
+
+def test_short_bucket_ladder_decodes_past_top_bucket(params):
+    """A custom ladder topping out below max_seq_len must not crash decode:
+    positions past the last bucket fall back to the full cache."""
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(0, TINY.vocab_size, size=(10,)).tolist()
+    g = GenerationConfig(max_new_tokens=16, sampling=SamplingConfig(greedy=True))
+    short = InferenceEngine(
+        TINY, params, max_batch=1, max_seq_len=64, buckets=[16]
+    )
+    full = InferenceEngine(TINY, params, max_batch=1, max_seq_len=64)
+    assert short.generate([prompt], g).sequences == full.generate([prompt], g).sequences
+
+
+def test_bert_decode_refused():
+    from neuronx_distributed_llama3_2_tpu.inference import decode_model_for
+    from neuronx_distributed_llama3_2_tpu.models import BERT_CONFIGS
+
+    with pytest.raises(NotImplementedError, match="bidirectional"):
+        decode_model_for(BERT_CONFIGS["tiny-bert"])
