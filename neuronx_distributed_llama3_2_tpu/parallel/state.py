@@ -122,6 +122,25 @@ class ParallelState:
 _PARALLEL_STATE: Optional[ParallelState] = None
 
 
+def dcn_mesh_shapes(
+    pp: int, dp: int, cp: int, ep: int, tp: int, num_hosts: int
+) -> Optional[Tuple[Tuple[int, ...], Tuple[int, ...]]]:
+    """(ici_shape, dcn_shape) for a hybrid multi-host mesh, or None when dp
+    does not divide the host count.
+
+    DCN (between hosts) is orders slower than ICI, so the slowest traffic —
+    the dp gradient all-reduce — is the axis that spans it (the reference's
+    multi-node layout too, run_llama3_70B_tp_pp.sh). ONLY dp may span hosts:
+    the data pipeline's contract is that each process feeds the batch rows
+    of its own dp block (data/dataset.py DistributedDataLoader slices by
+    process index), which holds exactly when hosts tile the dp axis in
+    order. A pp-over-DCN layout would put every dp row on every host and
+    break that contract, so it is deliberately not offered."""
+    if num_hosts <= 1 or dp % num_hosts != 0:
+        return None
+    return (pp, dp // num_hosts, cp, ep, tp), (1, num_hosts, 1, 1, 1)
+
+
 def build_mesh(
     config: ParallelConfig,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -132,7 +151,10 @@ def build_mesh(
     ``_build_and_assign_groups`` (parallel_state.py:388). tp is the innermost
     (fastest-varying) axis so TP collectives ride adjacent ICI links, the
     analogue of the reference's TP-contiguity rule (parallel_state.py:218-244).
+    On multi-host pods the mesh is built DCN-aware (hybrid): dp (or pp)
+    spans hosts, tp/cp/ep stay inside each host's ICI domain.
     """
+    explicit_devices = devices is not None
     if devices is None:
         devices = jax.devices()
     n = len(devices)
@@ -152,6 +174,27 @@ def build_mesh(
             f"data parallel size {dp_total} not divisible by expert_parallel_size {ep}"
         )
     dp = dp_total // ep
+    if not explicit_devices and jax.process_count() > 1:
+        shapes = dcn_mesh_shapes(pp, dp, cp, ep, tp, jax.process_count())
+        if shapes is not None:
+            try:
+                from jax.experimental import mesh_utils
+
+                dev_array = mesh_utils.create_hybrid_device_mesh(
+                    shapes[0], shapes[1], devices=devices
+                )
+                return Mesh(dev_array, MESH_AXES)
+            except Exception as e:  # non-uniform hosts etc. — plain reshape
+                logger.warning(
+                    "hybrid DCN mesh construction failed (%s); falling back "
+                    "to device-order reshape", e,
+                )
+        else:
+            logger.warning(
+                "dp=%d does not divide the %d hosts: DCN traffic will not "
+                "be confined to the dp axis (pick dp a multiple of the host "
+                "count for multi-host runs)", dp, jax.process_count(),
+            )
     dev_array = np.asarray(devices).reshape(pp, dp, cp, ep, tp)
     return Mesh(dev_array, MESH_AXES)
 

