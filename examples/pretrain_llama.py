@@ -56,6 +56,11 @@ def parse_args():
     p.add_argument("--keep-ckpts", type=int, default=3)
     p.add_argument("--metrics-file", default=None)
     p.add_argument(
+        "--eval-every", type=int, default=0,
+        help="run a held-out eval every N steps (0 = off)",
+    )
+    p.add_argument("--eval-batches", type=int, default=4)
+    p.add_argument(
         "--tensorboard-dir", default=None,
         help="write TensorBoard scalar events (loss/grad_norm/lr/seq_s)",
     )
@@ -111,6 +116,7 @@ def main():
         TrainState,
         TrainingConfig,
         initialize_parallel_model,
+        make_eval_step,
         make_train_step,
     )
     from neuronx_distributed_llama3_2_tpu.trainer.metrics import (
@@ -202,11 +208,33 @@ def main():
                            "using the numpy loader")
     if dataset is None:
         dataset = TokenDataset(data_path, args.seq_len)
+    # train/eval holdout: eval owns the TAIL of the sample space and its own
+    # plain-numpy dataset handle — the native train dataset's one-slot
+    # prefetch must never be shared (an eval gather would clobber the train
+    # loop's outstanding prefetch and silently cross the data streams)
+    n_samples = len(dataset)
+    eval_loader = None
+    train_range = None
+    if args.eval_every:
+        eval_n = max(args.global_batch * args.eval_batches, n_samples // 20)
+        if n_samples - eval_n < args.global_batch:
+            raise SystemExit(
+                f"dataset too small to hold out {eval_n} eval samples"
+            )
+        train_range = (0, n_samples - eval_n)
+        eval_loader = DistributedDataLoader(
+            TokenDataset(data_path, args.seq_len),
+            args.global_batch,
+            shuffle=False,
+            sample_range=(n_samples - eval_n, n_samples),
+        )
     loader = DistributedDataLoader(
         dataset,
         args.global_batch,
         seed=args.seed,
+        sample_range=train_range,
     )
+    eval_step_fn = None  # built lazily, once (jit cache lives on the fn)
 
     # -- model/optimizer state (fresh, then maybe overwritten by resume) ---
     state, _ = initialize_parallel_model(model, config)
@@ -355,6 +383,41 @@ def main():
                     **({"train/seqs_per_s": seqs_per_s} if seqs_per_s else {}),
                 },
             )
+        if eval_loader is not None and (step + 1) % args.eval_every == 0:
+            from neuronx_distributed_llama3_2_tpu.trainer import evaluate
+
+            if eval_step_fn is None:
+                eval_step_fn = make_eval_step(model, config)
+
+            def eval_batches():
+                # stateless fixed slice (batch_at): identical samples every
+                # interval, so successive eval losses are comparable
+                for i in range(args.eval_batches):
+                    ev = np.array(eval_loader.batch_at(i))
+                    if is_bert:
+                        # fixed-seed MLM masking: same positions each eval
+                        mrng = np.random.default_rng(args.seed * 7919 + i)
+                        lbl = np.full_like(ev, -100)
+                        pick = mrng.random(ev.shape) < 0.15
+                        lbl[pick] = ev[pick]
+                        ev = ev.copy()
+                        ev[pick] = model_cfg.vocab_size - 1
+                    else:
+                        lbl = ev
+                    yield {
+                        "input_ids": batch_to_device(ev, mesh),
+                        "labels": batch_to_device(lbl, mesh),
+                    }
+
+            ev_loss = evaluate(
+                model, config, state.params, eval_batches(),
+                eval_step=eval_step_fn,
+            )
+            logger.info("step %d eval_loss %.4f", step, ev_loss)
+            if tb:
+                tb.log_scalars(step, {"eval/loss": ev_loss})
+            if metrics_file:
+                metrics_file.log(step, eval_loss=ev_loss)
         if (step + 1) % args.save_every == 0 and step + 1 < args.steps:
             with timeline.event("save_checkpoint", cat="ckpt", step=step + 1):
                 save(step + 1)

@@ -14,7 +14,7 @@ skip-to-step resume.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -76,10 +76,21 @@ class DistributedDataLoader:
         seed: int = 0,
         shuffle: bool = True,
         state: Optional[LoaderState] = None,
+        sample_range: Optional[Tuple[int, int]] = None,
     ):
-        if len(dataset) < global_batch_size:
+        """``sample_range=(lo, hi)`` restricts the loader to dataset samples
+        [lo, hi) — the train/eval holdout split (the reference holds out a
+        separate hdf5 shard; here two loaders over disjoint ranges of one
+        token stream give the same guarantee)."""
+        lo, hi = sample_range if sample_range is not None else (0, len(dataset))
+        if not (0 <= lo < hi <= len(dataset)):
             raise ValueError(
-                f"dataset has {len(dataset)} samples < global batch "
+                f"sample_range {sample_range} invalid for dataset of "
+                f"{len(dataset)} samples"
+            )
+        if hi - lo < global_batch_size:
+            raise ValueError(
+                f"sample range has {hi - lo} samples < global batch "
                 f"{global_batch_size}"
             )
         self.dataset = dataset
@@ -87,7 +98,8 @@ class DistributedDataLoader:
         self.seed = seed
         self.shuffle = shuffle
         self.state = state or LoaderState()
-        self.steps_per_epoch = len(dataset) // global_batch_size
+        self.range_lo, self.range_hi = lo, hi
+        self.steps_per_epoch = (hi - lo) // global_batch_size
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         # cached per epoch: the permutation is O(dataset) and must not run
@@ -97,11 +109,11 @@ class DistributedDataLoader:
             return cached[1]
         n = self.steps_per_epoch * self.gbs
         if not self.shuffle:
-            order = np.arange(n)
+            order = np.arange(self.range_lo, self.range_lo + n)
         else:
-            order = np.random.default_rng(self.seed + epoch).permutation(
-                len(self.dataset)
-            )[:n]
+            order = self.range_lo + np.random.default_rng(
+                self.seed + epoch
+            ).permutation(self.range_hi - self.range_lo)[:n]
         self._order_cache = (epoch, order)
         return order
 

@@ -13,7 +13,9 @@ from neuronx_distributed_llama3_2_tpu.trainer.tensorboard import (  # noqa: F401
 )
 from neuronx_distributed_llama3_2_tpu.trainer.trainer import (  # noqa: F401
     TrainState,
+    evaluate,
     initialize_parallel_model,
+    make_eval_step,
     make_train_step,
     train_state_specs,
 )

@@ -219,3 +219,45 @@ def make_train_step(
         return TrainState(params=new_params, opt=new_opt), metrics
 
     return jax.jit(train_step, donate_argnums=0)
+
+
+def make_eval_step(model, config: TrainingConfig) -> Callable:
+    """Compiled evaluation step: (params, batch) -> loss (fp32 scalar).
+
+    The role of the reference's ``run_eval`` / ``InferenceSchedule`` path
+    (pipeline/model.py:790, scheduler.py:144): the same loss as training
+    with no gradients, no optimizer, and no microbatching (one forward over
+    the global batch; the pipelined model does its own microbatch rotation
+    inside ``loss``). Works with every model exposing the causal-LM
+    ``loss(params, input_ids, labels)`` protocol, including
+    :class:`~..pipeline.PipelinedCausalLM`.
+    """
+
+    def eval_step(params, batch):
+        input_ids, labels = batch["input_ids"], batch["labels"]
+        input_ids = jax.lax.with_sharding_constraint(
+            input_ids,
+            NamedSharding(
+                parallel_state.get_parallel_state().mesh, P(BATCH_AXES, None)
+            ),
+        )
+        return model.loss(params, input_ids, labels).astype(jnp.float32)
+
+    return jax.jit(eval_step)
+
+
+def evaluate(
+    model, config: TrainingConfig, params, batches, eval_step=None
+) -> float:
+    """Mean eval loss over an iterable of batches (the reference's eval
+    loop around run_eval). Pass a prebuilt ``eval_step`` (from
+    :func:`make_eval_step`) when calling repeatedly — a fresh jit wrapper
+    per call would recompile the eval program every interval."""
+    step = eval_step if eval_step is not None else make_eval_step(model, config)
+    total, n = 0.0, 0
+    for batch in batches:
+        total += float(step(params, batch))
+        n += 1
+    if n == 0:
+        raise ValueError("evaluate() got an empty batch iterable")
+    return total / n

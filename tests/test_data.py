@@ -85,3 +85,41 @@ def test_pretrain_script_resume(tmp_path):
     # metrics file recorded both runs
     lines = open(tmp_path / "m.jsonl").read().strip().splitlines()
     assert len(lines) == 6
+
+
+def test_sample_range_holdout_disjoint(tmp_path):
+    """Train/eval loaders over disjoint sample ranges never share a sample
+    (the holdout split that replaces the reference's separate eval shard)."""
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.data import (
+        DistributedDataLoader,
+        TokenDataset,
+        write_token_file,
+    )
+
+    path = str(tmp_path / "t.npy")
+    write_token_file(path, np.arange(64 * 40, dtype=np.int32))
+    ds = TokenDataset(path, 64)  # 40 samples
+    train = DistributedDataLoader(ds, 4, seed=0, sample_range=(0, 32))
+    ev = DistributedDataLoader(ds, 4, shuffle=False, sample_range=(32, 40))
+
+    seen_train = set()
+    for step in range(16):  # 2 epochs
+        for row in train.batch_at(step):
+            seen_train.add(int(row[0]) // 64)
+    seen_eval = set()
+    for step in range(2):
+        for row in ev.batch_at(step):
+            seen_eval.add(int(row[0]) // 64)
+    assert seen_train == set(range(32))
+    assert seen_eval == set(range(32, 40))
+    # fixed eval slice: the same batch every time
+    np.testing.assert_array_equal(ev.batch_at(0), ev.batch_at(0))
+
+    import pytest
+
+    with pytest.raises(ValueError, match="sample_range"):
+        DistributedDataLoader(ds, 4, sample_range=(30, 80))
+    with pytest.raises(ValueError, match="samples < global batch"):
+        DistributedDataLoader(ds, 16, sample_range=(32, 40))

@@ -164,3 +164,44 @@ def test_lr_schedule():
     assert abs(float(cfg.lr_at(110)) - 0.1) < 1e-6
     lin = dataclasses.replace(cfg, schedule="linear")
     assert abs(float(lin.lr_at(60)) - (0.1 + 0.9 * 0.5)) < 1e-6
+
+
+def test_eval_step_matches_loss_and_no_param_change():
+    """make_eval_step (reference run_eval/InferenceSchedule role): same loss
+    as model.loss, params untouched, works under tp + pp."""
+    import dataclasses
+
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+    )
+    from neuronx_distributed_llama3_2_tpu.pipeline import PipelinedCausalLM
+    from neuronx_distributed_llama3_2_tpu.trainer import (
+        evaluate,
+        make_eval_step,
+    )
+
+    parallel_state.destroy_model_parallel()
+    cfg = TrainingConfig(
+        tensor_parallel_size=2, pipeline_parallel_size=2,
+        optimizer=OptimizerConfig(zero_one_enabled=True, warmup_steps=1),
+    )
+    cfg.initialize(devices=jax.devices()[:8])
+    try:
+        tiny = LLAMA_CONFIGS["tiny"]
+        base = LlamaForCausalLM(tiny)
+        model = PipelinedCausalLM(base, num_microbatches=2)
+        state, _ = initialize_parallel_model(model, cfg)
+        ids = jnp.asarray(
+            np.random.default_rng(3).integers(0, tiny.vocab_size, (8, 32)),
+            jnp.int32,
+        )
+        batch = {"input_ids": ids, "labels": ids}
+        step = make_eval_step(model, cfg)
+        got = float(step(state.params, batch))
+        want = float(jax.jit(model.loss)(state.params, ids, ids))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        mean = evaluate(model, cfg, state.params, [batch, batch])
+        np.testing.assert_allclose(mean, want, rtol=1e-6)
+    finally:
+        parallel_state.destroy_model_parallel()
