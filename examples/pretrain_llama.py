@@ -131,6 +131,17 @@ def main():
 
     logger = get_logger()
 
+    def mask_mlm(tokens, rng, vocab_size):
+        """15% MLM masking: labels carry the true token at masked spots,
+        -100 elsewhere; [MASK] surrogate = vocab_size - 1 (synthetic
+        streams have no reserved mask id). One recipe for train AND eval."""
+        masked = np.array(tokens)
+        labels = np.full_like(masked, -100)
+        pick = rng.random(masked.shape) < 0.15
+        labels[pick] = masked[pick]
+        masked[pick] = vocab_size - 1
+        return masked, labels
+
     # any family's *_CONFIGS key works (llama / mixtral / dbrx / gpt-neox /
     # codegen / bert — the reference ships one pretrain script per family;
     # here one script serves the whole registry)
@@ -216,6 +227,8 @@ def main():
     eval_loader = None
     train_range = None
     if args.eval_every:
+        if args.eval_batches < 1:
+            raise SystemExit("--eval-batches must be >= 1 when --eval-every is set")
         eval_n = max(args.global_batch * args.eval_batches, n_samples // 20)
         if n_samples - eval_n < args.global_batch:
             raise SystemExit(
@@ -337,16 +350,13 @@ def main():
         with timeline.event("load_batch", cat="data"):
             batch = next(batches)
             if is_bert:
-                # MLM objective: mask 15% of positions; only those carry
-                # labels (causal next-token labels would make BERT's
-                # bidirectional encoder solve a trivial copy task).
-                # [MASK] surrogate = vocab_size - 1 on synthetic streams.
-                mask_rng = np.random.default_rng(args.seed * 100003 + step)
-                masked = np.array(batch)
-                labels = np.full_like(masked, -100)
-                pick = mask_rng.random(masked.shape) < 0.15
-                labels[pick] = masked[pick]
-                masked[pick] = model_cfg.vocab_size - 1
+                # MLM objective (causal next-token labels would make BERT's
+                # bidirectional encoder solve a trivial copy task)
+                masked, labels = mask_mlm(
+                    batch,
+                    np.random.default_rng(args.seed * 100003 + step),
+                    model_cfg.vocab_size,
+                )
                 ids = batch_to_device(masked, mesh)
                 lbl = batch_to_device(labels, mesh)
             else:
@@ -395,13 +405,12 @@ def main():
                 for i in range(args.eval_batches):
                     ev = np.array(eval_loader.batch_at(i))
                     if is_bert:
-                        # fixed-seed MLM masking: same positions each eval
-                        mrng = np.random.default_rng(args.seed * 7919 + i)
-                        lbl = np.full_like(ev, -100)
-                        pick = mrng.random(ev.shape) < 0.15
-                        lbl[pick] = ev[pick]
-                        ev = ev.copy()
-                        ev[pick] = model_cfg.vocab_size - 1
+                        # fixed-seed masking: same positions each eval
+                        ev, lbl = mask_mlm(
+                            ev,
+                            np.random.default_rng(args.seed * 7919 + i),
+                            model_cfg.vocab_size,
+                        )
                     else:
                         lbl = ev
                     yield {
@@ -418,6 +427,7 @@ def main():
                 tb.log_scalars(step, {"eval/loss": ev_loss})
             if metrics_file:
                 metrics_file.log(step, eval_loss=ev_loss)
+            throughput.reset()  # eval wall time must not read as a dip
         if (step + 1) % args.save_every == 0 and step + 1 < args.steps:
             with timeline.event("save_checkpoint", cat="ckpt", step=step + 1):
                 save(step + 1)

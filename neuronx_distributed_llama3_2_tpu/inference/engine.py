@@ -135,6 +135,25 @@ class InferenceEngine:
             )
         self._programs: Dict[Tuple, Callable] = {}
 
+    def _live_params(self, params):
+        """Dequantize QuantizedTensor leaves INSIDE the jitted program
+        (identity for float trees): int8/fp8 payloads stay resident in HBM
+        and the dequant multiply fuses into each consuming matmul — the
+        quantized-serving mode of the reference's run_llama_quantized.py,
+        where HBM holds int8 weights and the MXU sees bf16."""
+        from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
+            QuantizedTensor,
+            dequantize_params,
+        )
+
+        has_q = any(
+            isinstance(l, QuantizedTensor)
+            for l in jax.tree.leaves(
+                params, is_leaf=lambda l: isinstance(l, QuantizedTensor)
+            )
+        )
+        return dequantize_params(params, self.config.dtype) if has_q else params
+
     def _kv_bucket(self, needed: int) -> int:
         """Token-gen cache bucket covering ``needed`` rows; positions past a
         short custom ladder fall back to the full cache (decode must keep
@@ -154,6 +173,7 @@ class InferenceEngine:
         model = self.model
 
         def prefill(params, cache, ids, lengths, slots, key):
+            params = self._live_params(params)
             positions = jnp.zeros((ids.shape[0],), jnp.int32)
             hidden, cache = model.forward(
                 params, cache, ids, positions, slots,
@@ -184,6 +204,7 @@ class InferenceEngine:
         model = self.model
 
         def decode(params, cache, tokens, positions, slots, key):
+            params = self._live_params(params)
             logits, cache = model.forward(
                 params, cache, tokens[:, None], positions, slots,
                 kv_limit=kv_limit,
@@ -213,6 +234,7 @@ class InferenceEngine:
         model = self.model
 
         def decode_n(params, cache, tokens, positions, slots, key):
+            params = self._live_params(params)
             # the key chains exactly like the host loop (one split per
             # token), so any on_device_steps yields the same sampled
             # sequence as the per-token path for a given seed
@@ -246,7 +268,9 @@ class InferenceEngine:
         model = self.model
 
         def verify(params, cache, tokens, positions, slots):
-            return model.forward(params, cache, tokens, positions, slots)
+            return model.forward(
+                self._live_params(params), cache, tokens, positions, slots
+            )
 
         fn = jax.jit(verify, donate_argnums=(1,))
         self._programs[key_] = fn
@@ -440,7 +464,7 @@ class InferenceEngine:
         positions = jnp.zeros((b,), jnp.int32)
         logits, _ = jax.jit(
             lambda p, c, i, pos: self.model.forward(
-                p, c, i, pos, context_encode=True
+                self._live_params(p), c, i, pos, context_encode=True
             )
         )(self.params, cache, input_ids, positions)
         return logits

@@ -221,6 +221,9 @@ class MedusaDecoder:
         return int(greedy[0, last]), hidden, last
 
     def _fwd_hidden(self, p, cache, toks, pos, *, context_encode=False, tree=None):
+        # every Medusa program funnels through here: dequantize inside jit
+        # like the engine's own programs (int8-resident serving support)
+        p = self.engine._live_params(p)
         hidden, cache = self.engine.model.forward(
             p, cache, toks, pos,
             context_encode=context_encode, return_hidden=True, tree=tree,

@@ -38,6 +38,11 @@ class Throughput:
         iters = len(self.times) - 1
         return self.seqs_per_iteration * iters / span
 
+    def reset(self) -> None:
+        """Drop the window — call after non-training wall time (eval,
+        checkpoint) so the next readings don't report a phantom dip."""
+        self.times.clear()
+
 
 class TrainingMetrics:
     """Append-only JSON-lines metrics file (reference TrainingMetrics

@@ -23,7 +23,9 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def tpu_gate(seq: int) -> None:
+def tpu_gate(
+    seq: int, min_attn_util: float = 0.2, max_peak_gb: float = 14.0
+) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -52,19 +54,35 @@ def tpu_gate(seq: int) -> None:
     )
     dt = time.perf_counter() - t0
     flops = 2 * 2 * B * N * seq * seq * D * 0.5 * 3.5  # fwd+bwd causal
+    util = flops / dt / 197e12
+    stats = jax.devices()[0].memory_stats() or {}
+    peak_gb = stats.get("peak_bytes_in_use", 0) / 2**30
+    # the reference's CI classification (test_long_seqlen.py:13-60:
+    # SUCCEEDED / ERRORS / MEMORY_DEGRADATION / PERFORMANCE_DEGRADATION
+    # against passed-in thresholds)
+    if not finite:
+        status = "ERRORS"
+    elif peak_gb > max_peak_gb:
+        status = "MEMORY_DEGRADATION"
+    elif util < min_attn_util:
+        status = "PERFORMANCE_DEGRADATION"
+    else:
+        status = "SUCCEEDED"
     print(
         json.dumps(
             {
                 "gate": "long_context_tpu",
                 "seq": seq,
-                "ok": finite,
+                "status": status,
+                "ok": status == "SUCCEEDED",
                 "fwd_bwd_ms": round(dt * 1e3, 1),
-                "attn_util": round(flops / dt / 197e12, 3),
+                "attn_util": round(util, 3),
+                "peak_hbm_gb": round(peak_gb, 2),
                 "backend": jax.default_backend(),
             }
         )
     )
-    if not finite:
+    if status != "SUCCEEDED":
         raise SystemExit(1)
 
 
@@ -108,7 +126,15 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--seq", type=int, default=32768)
     p.add_argument("--cp", action="store_true", help="also gate ring attention cp=8")
+    p.add_argument(
+        "--min-attn-util", type=float, default=0.2,
+        help="below this attention MFU → PERFORMANCE_DEGRADATION",
+    )
+    p.add_argument(
+        "--max-peak-gb", type=float, default=14.0,
+        help="above this peak HBM → MEMORY_DEGRADATION",
+    )
     args = p.parse_args()
-    tpu_gate(args.seq)
+    tpu_gate(args.seq, args.min_attn_util, args.max_peak_gb)
     if args.cp:
         cp_gate(args.seq)

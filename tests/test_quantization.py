@@ -298,3 +298,29 @@ def test_bert_projections_quantized():
     qparams = quantize_params(params)
     # qkv(3) + o + up + down
     assert _n_quantized(qparams) == 6
+
+
+def test_engine_serves_quantized_tree_directly():
+    """The engine accepts a params tree with QuantizedTensor leaves and
+    dequantizes inside each compiled program (int8 stays HBM-resident —
+    reference run_llama_quantized.py serving mode); tokens match serving
+    the pre-dequantized tree."""
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+        SamplingConfig,
+    )
+
+    model = LlamaForCausalLM(TINY)
+    params = model.init(jax.random.key(14))
+    qparams = quantize_params(params)
+    prompt = np.random.default_rng(3).integers(0, TINY.vocab_size, (8,)).tolist()
+    g = GenerationConfig(max_new_tokens=6, sampling=SamplingConfig(greedy=True))
+
+    eng_q = InferenceEngine(TINY, qparams, max_batch=1, max_seq_len=64)
+    got = eng_q.generate([prompt], g).sequences[0]
+    eng_f = InferenceEngine(
+        TINY, dequantize_params(qparams, TINY.dtype), max_batch=1, max_seq_len=64
+    )
+    want = eng_f.generate([prompt], g).sequences[0]
+    assert got == want
