@@ -431,6 +431,7 @@ def main():
         if (step + 1) % args.save_every == 0 and step + 1 < args.steps:
             with timeline.event("save_checkpoint", cat="ckpt", step=step + 1):
                 save(step + 1)
+            throughput.reset()  # blocking save time isn't training time
         timeline.step_end(step)
     # skip on a no-op resume: rewriting the completed final checkpoint would
     # unmark done and risk losing it if killed mid-write

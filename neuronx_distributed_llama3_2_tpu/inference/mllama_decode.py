@@ -59,6 +59,23 @@ class MllamaDecoder:
     the logit-parity gate path; batching rides the same programs)."""
 
     def __init__(self, config: MllamaConfig, params: Params, max_seq_len: int = 512):
+        from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
+            QuantizedTensor,
+        )
+
+        if any(
+            isinstance(l, QuantizedTensor)
+            for l in jax.tree.leaves(
+                params, is_leaf=lambda l: isinstance(l, QuantizedTensor)
+            )
+        ):
+            # this decoder slices params eagerly (precompute_cross_kv) and
+            # its programs don't dequantize in-jit like the text engine's;
+            # refuse rather than crash mid-trace or matmul raw int8
+            raise NotImplementedError(
+                "MllamaDecoder does not support quantized parameter trees; "
+                "pass dequantize_params(qparams, config.text.dtype)"
+            )
         self.config = config
         self.params = params
         self.max_seq_len = max_seq_len

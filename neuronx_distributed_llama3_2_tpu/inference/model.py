@@ -287,15 +287,19 @@ class LlamaDecode:
         ``cache_index <= position + t`` (block-causal across the fresh block,
         full visibility of the committed prefix; garbage rows beyond the
         write frontier are masked out — reference manual prior+active softmax
-        combine, attention_base.py:141-167, done here as one masked softmax)."""
+        combine, attention_base.py:141-167, done here as one masked softmax).
+
+        GQA runs as grouped einsums (q reshaped (b,T,NKV,G,D)) rather than
+        ``jnp.repeat`` of the cache: decode is cache-bandwidth-bound and the
+        repeat would materialize an N/NKV-times-larger K/V read (4x on
+        Llama-3.2 geometry)."""
         b, t, n, d = q.shape
         s_max = k_all.shape[1]
         nkv = k_all.shape[2]
-        if nkv != n:
-            rep = n // nkv
-            k_all = jnp.repeat(k_all, rep, axis=2)
-            v_all = jnp.repeat(v_all, rep, axis=2)
-        scores = jnp.einsum("bsnd,btnd->bnst", q, k_all) * (d ** -0.5)
+        g = n // nkv
+        qg = q.reshape(b, t, nkv, g, d)
+        scores = jnp.einsum("bskd,btkgd->bkgts", k_all, qg) * (d ** -0.5)
+        scores = scores.reshape(b, n, t, s_max)
         scores = constrain(scores, P(BATCH_AXES, ha, None, None))
         scores = scores.astype(jnp.float32)
         j = jax.lax.iota(jnp.int32, s_max)[None, None, :]  # (1,1,S_max)
@@ -315,7 +319,8 @@ class LlamaDecode:
             mask = prefix_ok | (in_block & tree_ok)
         scores = jnp.where(mask[:, None, :, :], scores, jnp.float32(-1e30))
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        out = jnp.einsum("bnst,btnd->bsnd", probs, v_all)
+        pg = probs.reshape(b, nkv, g, t, s_max)
+        out = jnp.einsum("bkgts,bskd->btkgd", pg, v_all).reshape(b, t, n, d)
         return constrain(out, P(BATCH_AXES, None, ha, None))
 
 
