@@ -123,5 +123,32 @@ def main():
     )
 
 
+def main_with_retries(attempts: int = 3, backoff_s: float = 60.0) -> None:
+    """The tunneled dev chip's relay occasionally drops with UNAVAILABLE
+    backend-init errors and recovers within minutes; retry so a transient
+    flap doesn't cost the round's benchmark artifact."""
+    for i in range(attempts):
+        try:
+            main()
+            return
+        except RuntimeError as e:
+            transient = "UNAVAILABLE" in str(e) or "Unable to initialize" in str(e)
+            if not transient or i == attempts - 1:
+                raise
+            # a mid-run drop leaves the parallel state initialized; clear it
+            # or the retry dies on "already initialized" instead
+            from neuronx_distributed_llama3_2_tpu.parallel import (
+                state as parallel_state,
+            )
+
+            parallel_state.destroy_model_parallel()
+            print(
+                f"# backend unavailable (attempt {i + 1}/{attempts}): {e}; "
+                f"retrying in {backoff_s:.0f}s",
+                flush=True,
+            )
+            time.sleep(backoff_s)
+
+
 if __name__ == "__main__":
-    main()
+    main_with_retries()

@@ -11,10 +11,11 @@ per-layer router logits feed the Switch load-balancing loss
 
 Implements the same model protocol as :class:`.llama.LlamaForCausalLM`
 (init/specs/__call__/loss/loss_from_hidden), so the trainer and checkpoint
-layers work unchanged. The GPipe pipeline executor supports MoE: its stage
-scan carries a router-aux stream alongside the hidden state
-(:class:`..pipeline.PipelinedCausalLM`, validity-masked over fill/drain
-rotations); the 1F1B executor remains dense-only.
+layers work unchanged. Both pipeline executors support MoE
+(:class:`..pipeline.PipelinedCausalLM`): the GPipe stage scan carries a
+router-aux stream alongside the hidden state (validity-masked over
+fill/drain rotations), and the 1F1B manual-VJP executor feeds the aux term
+in as a constant cotangent on each stage's aux output.
 """
 
 from __future__ import annotations

@@ -83,11 +83,21 @@ class PipelinedCausalLM:
             raise ValueError(
                 f"schedule must be one of {SCHEDULES}, got {self.schedule!r}"
             )
-        if self._is_moe() and self.schedule == "1f1b":
+        if (
+            self._is_moe()
+            and self.schedule == "1f1b"
+            and parallel_state.model_parallel_is_initialized()
+            and parallel_state.get_tensor_model_parallel_size() > 1
+        ):
+            # the expert-einsum transposes inside the pp-manual VJP region
+            # make XLA's SPMD partitioner derive inconsistent replica groups
+            # under tp and die on a CHECK (spmd_partitioner_util.cc:495);
+            # MoE 1F1B supports pp x dp (the memory-bound case it exists
+            # for) — use gpipe for MoE with tensor parallelism
             raise ValueError(
-                "MoE pipelining runs under schedule='gpipe' (the 1f1b "
-                "manual-VJP executor carries a plain hidden stream; the "
-                "router aux stream is gpipe-only today)"
+                "MoE + schedule='1f1b' + tensor parallelism is not supported "
+                "(XLA SPMD partitioner limitation); use schedule='gpipe' for "
+                "MoE with tp > 1, or 1f1b with tp=1"
             )
 
     def _is_moe(self) -> bool:
@@ -148,32 +158,37 @@ class PipelinedCausalLM:
 
     # -- execution -------------------------------------------------------
 
+    def _scan_stage(self, stage_layers, x, sin, cos, positions):
+        """One stage's layer scan: (L/pp-stacked params, x) → (y, aux_mean).
+        MoE layers return (x, router aux); dense layers contribute aux 0.
+        The single stage body shared by BOTH executors — gpipe and 1F1B must
+        never diverge on the layer protocol."""
+        layer = self.model._layer()
+        moe = self._is_moe()
+        policy = _remat_policy(self.config.remat)
+
+        def body(x, one_layer):
+            out = layer(one_layer, x, sin, cos, positions)
+            if moe:
+                return out[0], out[1]
+            return out, jnp.float32(0.0)
+
+        if policy is not None:
+            body = jax.checkpoint(body, policy=policy)
+        y, auxes = lax.scan(body, x, stage_layers)
+        return y, jnp.mean(auxes)
+
     def _stage_apply(self, stage_layers, stream, sin, cos, positions):
         """Every stage applies its layer block to its current microbatch.
         shard_map manual over pp only; tp/sp/dp shardings inside the stage
         body remain GSPMD-auto, so the per-layer constraints keep working."""
-        cfg = self.config
-        layer = self.model._layer()
         mesh = parallel_state.get_parallel_state().mesh
-        policy = _remat_policy(cfg.remat)
-
-        moe = self._is_moe()
 
         def body(stage_layers_l, stream_l, sin, cos, positions):
             x = stream_l[0]  # (mbs, S, H) — this stage's microbatch
             lp = jax.tree.map(lambda p: p[0], stage_layers_l)
-
-            def layer_body(x, one_layer):
-                out = layer(one_layer, x, sin, cos, positions)
-                if moe:
-                    x, aux = out  # MoE layers return (x, router aux loss)
-                    return x, aux
-                return out, jnp.float32(0.0)
-
-            if policy is not None:
-                layer_body = jax.checkpoint(layer_body, policy=policy)
-            x, auxes = lax.scan(layer_body, x, lp)
-            return x[None], jnp.mean(auxes)[None]
+            x, aux = self._scan_stage(lp, x, sin, cos, positions)
+            return x[None], aux[None]
 
         layer_specs = jax.tree.map(
             lambda _: P(PP_AXIS),
@@ -332,7 +347,6 @@ class PipelinedCausalLM:
         D = 2 * pp - 1  # stash ring depth ≥ max in-flight (2(pp-1)) + 1
         T = M + 2 * (pp - 1)
         mesh = parallel_state.get_parallel_state().mesh
-        policy = _remat_policy(cfg.remat)
 
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mbs, S))
         sin, cos = self.model._rope(S)
@@ -351,18 +365,20 @@ class PipelinedCausalLM:
             1.0,
         )
 
-        layer = self.model._layer()
         embed = self.model._embed()
         head_params = self._head_params(params)
+        moe = self._is_moe()
+        # per-(stage, microbatch) router-aux weight: loss adds
+        # coef · mean(aux over pp·M stage-visits), so each visit's cotangent
+        # is the constant coef/(pp·M) — how the aux term enters a manual VJP
+        aux_ct = (
+            jnp.float32(cfg.router_aux_loss_coef / (pp * M))
+            if moe
+            else jnp.float32(0.0)
+        )
 
         def stage_fwd(stage_layers, x):
-            def body(x, one_layer):
-                return layer(one_layer, x, sin, cos, positions), None
-
-            if policy is not None:
-                body = jax.checkpoint(body, policy=policy)
-            y, _ = lax.scan(body, x, stage_layers)
-            return y
+            return self._scan_stage(stage_layers, x, sin, cos, positions)
 
         def lane_body(stage_layers, head_p, embed_p, ids_all, lab_all):
             """Runs on one pp lane (manual over pp; tp/dp stay auto)."""
@@ -389,6 +405,7 @@ class PipelinedCausalLM:
                 "stash": jnp.zeros((D, mbs, S, H), cfg.dtype),
                 "grads": zeros_g,
                 "loss_sum": jnp.float32(0.0),
+                "aux_sum": jnp.float32(0.0),
             }
 
             def rotation(carry, t):
@@ -415,7 +432,10 @@ class PipelinedCausalLM:
                 stash = lax.dynamic_update_index_in_dim(
                     carry["stash"], x_in, t % D, axis=0
                 )
-                y = stage_fwd(stage_layers, x_in)
+                y, aux_m = stage_fwd(stage_layers, x_in)
+                aux_sum = carry["aux_sum"] + jnp.where(
+                    fwd_valid, aux_m.astype(jnp.float32), 0.0
+                )
 
                 # ---- head (value used on the last lane only) ----
                 def head_fn(hp, h):
@@ -442,7 +462,9 @@ class PipelinedCausalLM:
                 _, stage_vjp = jax.vjp(
                     lambda w, x: stage_fwd(w, x), stage_layers, x_saved
                 )
-                dw, dx = stage_vjp(dy_in)
+                # (dy, daux): the router-aux gradient rides the same stage
+                # VJP as a constant cotangent on the aux output
+                dw, dx = stage_vjp((dy_in, aux_ct))
 
                 # embedding bwd on lane 0: dx is d(embed output)
                 _, embed_vjp = jax.vjp(lambda e: embed(e, ids_b), embed_p)
@@ -477,6 +499,7 @@ class PipelinedCausalLM:
                     "stash": stash,
                     "grads": grads,
                     "loss_sum": loss_sum,
+                    "aux_sum": aux_sum,
                 }, None
 
             carry, _ = lax.scan(rotation, carry0, jnp.arange(T))
@@ -484,6 +507,11 @@ class PipelinedCausalLM:
             # the last lane only. Grads were seeded with cotangent
             # 1/total_count, so normalize the loss the same way here.
             loss = lax.psum(carry["loss_sum"], PP_AXIS) / total_count
+            if moe:
+                # matches the gpipe/unpipelined objective: per-(stage,
+                # microbatch) aux mean times the coefficient
+                aux_mean = lax.psum(carry["aux_sum"], PP_AXIS) / (pp * M)
+                loss = loss + cfg.router_aux_loss_coef * aux_mean
             head_g = jax.tree.map(
                 lambda x: lax.psum(x, PP_AXIS), carry["grads"]["head"]
             )

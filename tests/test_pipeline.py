@@ -399,15 +399,71 @@ def test_moe_pipeline_trains():
     assert np.isfinite(losses).all()
 
 
-def test_moe_rejects_1f1b():
+def test_moe_1f1b_matches_gpipe_and_autodiff():
+    """MoE under the 1F1B manual-VJP executor: loss AND grads match the
+    gpipe (autodiff) executor — the router-aux cotangent path is exact."""
     from neuronx_distributed_llama3_2_tpu.models.mixtral import (
         MIXTRAL_CONFIGS,
         MixtralForCausalLM,
     )
 
-    with pytest.raises(ValueError):
-        PipelinedCausalLM(
-            MixtralForCausalLM(MIXTRAL_CONFIGS["tiny-moe"]),
-            num_microbatches=2,
-            schedule="1f1b",
+    cfg = MIXTRAL_CONFIGS["tiny-moe"]
+    model = MixtralForCausalLM(cfg)
+    params = model.init(jax.random.key(4))
+    # microbatch rows must cover the dp axis (mbs=8 over dp=4): degenerate
+    # mbs < dp trips an XLA:CPU partitioner CHECK in the MoE scatter
+    # transpose inside the pp-manual region
+    ids = jnp.asarray(
+        np.random.default_rng(4).integers(0, cfg.vocab_size, (32, 16)), jnp.int32
+    )
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(pipeline_model_parallel_size=2)
+    try:
+        gp = PipelinedCausalLM(model, num_microbatches=4, schedule="gpipe")
+        pp_params = shard_pytree(gp.to_pipeline(params), gp.specs())
+        ref_loss, ref_grads = jax.jit(jax.value_and_grad(gp.loss))(
+            pp_params, ids, ids
         )
+        fb = PipelinedCausalLM(model, num_microbatches=4, schedule="1f1b")
+        loss, grads = jax.jit(fb.loss_and_grad)(pp_params, ids, ids)
+        np.testing.assert_allclose(
+            float(loss), float(ref_loss), rtol=1e-5, atol=1e-5
+        )
+        from neuronx_distributed_llama3_2_tpu.checkpoint.checkpoint import (
+            _flatten,
+        )
+
+        flat_ref = _flatten(ref_grads)
+        flat_got = _flatten(grads)
+        assert set(flat_ref) == set(flat_got)
+        for key in flat_ref:
+            np.testing.assert_allclose(
+                np.asarray(flat_got[key], np.float32),
+                np.asarray(flat_ref[key], np.float32),
+                atol=5e-4, rtol=1e-3, err_msg=key,
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_moe_1f1b_rejects_tp():
+    """MoE + 1f1b + tp>1 trips an XLA partitioner CHECK; refuse clearly."""
+    from neuronx_distributed_llama3_2_tpu.models.mixtral import (
+        MIXTRAL_CONFIGS,
+        MixtralForCausalLM,
+    )
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=2, pipeline_model_parallel_size=2
+    )
+    try:
+        with pytest.raises(ValueError, match="gpipe"):
+            PipelinedCausalLM(
+                MixtralForCausalLM(MIXTRAL_CONFIGS["tiny-moe"]),
+                num_microbatches=2,
+                schedule="1f1b",
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
