@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import sys
 import time
 
 import jax
@@ -145,6 +146,7 @@ def main_with_retries(attempts: int = 3, backoff_s: float = 60.0) -> None:
             print(
                 f"# backend unavailable (attempt {i + 1}/{attempts}): {e}; "
                 f"retrying in {backoff_s:.0f}s",
+                file=sys.stderr,
                 flush=True,
             )
             time.sleep(backoff_s)

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Llama-3 70B pretraining on a v5e/v5p pod slice — the counterpart of the
+# reference's run_llama3_70B_tp_pp.sh (TP=32 PP=8 GBS=1024 SEQ=8192 on
+# trn1.32xl; here tp rides ICI inside each host and dp spans hosts, so the
+# tp degree stays at the per-host chip count).
+#
+# One process per host (jax.distributed auto-discovers the coordinator on
+# Cloud TPU); run this same script on every host of the slice.
+set -euo pipefail
+
+CKPT_DIR=${CKPT_DIR:-/checkpoints/llama3-70b}
+DATA=${DATA:?set DATA=/path/to/tokens.npy}
+
+python examples/pretrain_llama.py \
+    --model llama3-70b \
+    --tp 8 --pp 8 --sp \
+    --microbatches 32 \
+    --global-batch 1024 \
+    --seq-len 8192 \
+    --steps "${STEPS:-10000}" \
+    --lr 1.5e-4 --warmup-steps 2000 \
+    --data "$DATA" \
+    --ckpt-dir "$CKPT_DIR" \
+    --save-every 250 --keep-ckpts 3 --async-save \
+    --eval-every 500 \
+    --tensorboard-dir "$CKPT_DIR/tb" \
+    --native-loader \
+    "$@"

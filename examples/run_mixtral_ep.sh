@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Mixtral 8x7B pretraining with expert parallelism — the counterpart of the
+# reference's examples/training/mixtral launch flow
+# (neuronx_distributed_config(expert_parallel_size=...)).
+set -euo pipefail
+
+CKPT_DIR=${CKPT_DIR:-/checkpoints/mixtral-8x7b}
+DATA=${DATA:?set DATA=/path/to/tokens.npy}
+
+python examples/pretrain_llama.py \
+    --model mixtral-8x7b \
+    --tp 4 --ep 8 --sp \
+    --capacity-factor 4.0 \
+    --global-batch 256 \
+    --seq-len 4096 \
+    --steps "${STEPS:-10000}" \
+    --lr 1e-4 --warmup-steps 1000 \
+    --data "$DATA" \
+    --ckpt-dir "$CKPT_DIR" \
+    --save-every 250 --keep-ckpts 3 --async-save \
+    --tensorboard-dir "$CKPT_DIR/tb" \
+    "$@"

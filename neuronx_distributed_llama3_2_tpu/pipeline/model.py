@@ -83,21 +83,29 @@ class PipelinedCausalLM:
             raise ValueError(
                 f"schedule must be one of {SCHEDULES}, got {self.schedule!r}"
             )
-        if (
+        self._check_moe_1f1b_mesh()
+
+    def _check_moe_1f1b_mesh(self) -> None:
+        """MoE 1F1B supports pp x dp only: the expert-einsum transposes (and
+        EP all-to-alls) inside the pp-manual VJP region make XLA's SPMD
+        partitioner derive inconsistent replica groups under tp/ep and die
+        on a CHECK (spmd_partitioner_util.cc:495) — a process abort, so
+        validate here and again at loss_and_grad (construction may predate
+        the mesh)."""
+        if not (
             self._is_moe()
             and self.schedule == "1f1b"
             and parallel_state.model_parallel_is_initialized()
-            and parallel_state.get_tensor_model_parallel_size() > 1
         ):
-            # the expert-einsum transposes inside the pp-manual VJP region
-            # make XLA's SPMD partitioner derive inconsistent replica groups
-            # under tp and die on a CHECK (spmd_partitioner_util.cc:495);
-            # MoE 1F1B supports pp x dp (the memory-bound case it exists
-            # for) — use gpipe for MoE with tensor parallelism
+            return
+        if (
+            parallel_state.get_tensor_model_parallel_size() > 1
+            or parallel_state.get_expert_model_parallel_size() > 1
+        ):
             raise ValueError(
-                "MoE + schedule='1f1b' + tensor parallelism is not supported "
-                "(XLA SPMD partitioner limitation); use schedule='gpipe' for "
-                "MoE with tp > 1, or 1f1b with tp=1"
+                "MoE + schedule='1f1b' supports pp x dp meshes only (XLA "
+                "SPMD partitioner limitation under tp/ep inside the manual "
+                "VJP region); use schedule='gpipe' for MoE with tp or ep > 1"
             )
 
     def _is_moe(self) -> bool:
@@ -337,6 +345,7 @@ class PipelinedCausalLM:
         program on its own (mostly discarded) data — wasted flops worth
         head/(head+stage) per rotation; pick gpipe when memory allows.
         """
+        self._check_moe_1f1b_mesh()
         cfg = self.config
         pp, M = self._pp(), self.num_microbatches
         gbs, S = input_ids.shape
