@@ -85,18 +85,21 @@ class PipelinedCausalLM:
             )
         self._check_moe_1f1b_mesh()
 
-    def _check_moe_1f1b_mesh(self) -> None:
+    def _check_moe_1f1b_mesh(self, executing: bool = False) -> None:
         """MoE 1F1B supports pp x dp only: the expert-einsum transposes (and
         EP all-to-alls) inside the pp-manual VJP region make XLA's SPMD
         partitioner derive inconsistent replica groups under tp/ep and die
         on a CHECK (spmd_partitioner_util.cc:495) — a process abort, so
         validate here and again at loss_and_grad (construction may predate
         the mesh)."""
-        if not (
-            self._is_moe()
-            and self.schedule == "1f1b"
-            and parallel_state.model_parallel_is_initialized()
-        ):
+        # ``executing`` = called from loss_and_grad itself, which always
+        # runs the 1F1B executor no matter what schedule= says — the mesh
+        # check must not be skippable by constructing with schedule='gpipe'
+        if not self._is_moe():
+            return
+        if not (executing or self.schedule == "1f1b"):
+            return
+        if not parallel_state.model_parallel_is_initialized():
             return
         if (
             parallel_state.get_tensor_model_parallel_size() > 1
@@ -345,7 +348,7 @@ class PipelinedCausalLM:
         program on its own (mostly discarded) data — wasted flops worth
         head/(head+stage) per rotation; pick gpipe when memory allows.
         """
-        self._check_moe_1f1b_mesh()
+        self._check_moe_1f1b_mesh(executing=True)
         cfg = self.config
         pp, M = self._pp(), self.num_microbatches
         gbs, S = input_ids.shape
