@@ -1,0 +1,72 @@
+"""bench.py retry-wrapper tests: transient UNAVAILABLE drops retry (with
+parallel state cleared so re-init works); real errors propagate at once."""
+
+import importlib.util
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_transient_retries_then_succeeds(monkeypatch):
+    bench = _load_bench()
+    from neuronx_distributed_llama3_2_tpu.parallel import state as ps
+
+    calls = {"n": 0, "destroyed": 0}
+    orig_destroy = ps.destroy_model_parallel
+
+    def fake_destroy():
+        calls["destroyed"] += 1
+        orig_destroy()
+
+    monkeypatch.setattr(ps, "destroy_model_parallel", fake_destroy)
+
+    def fake_main():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # simulate a mid-run drop AFTER the mesh came up
+            ps.initialize_model_parallel()
+            raise RuntimeError("UNAVAILABLE: TPU backend setup/compile error")
+
+    monkeypatch.setattr(bench, "main", fake_main)
+    bench.main_with_retries(attempts=3, backoff_s=0.0)
+    assert calls["n"] == 2
+    assert calls["destroyed"] >= 1  # state cleared before the retry
+
+
+def test_non_transient_raises_immediately(monkeypatch):
+    bench = _load_bench()
+    calls = {"n": 0}
+
+    def fake_main():
+        calls["n"] += 1
+        raise RuntimeError("non-finite loss nan on the bench step")
+
+    monkeypatch.setattr(bench, "main", fake_main)
+    with pytest.raises(RuntimeError, match="non-finite"):
+        bench.main_with_retries(attempts=3, backoff_s=0.0)
+    assert calls["n"] == 1
+
+
+def test_exhausted_retries_raise(monkeypatch):
+    bench = _load_bench()
+    calls = {"n": 0}
+
+    def fake_main():
+        calls["n"] += 1
+        raise RuntimeError("UNAVAILABLE: still down")
+
+    monkeypatch.setattr(bench, "main", fake_main)
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        bench.main_with_retries(attempts=3, backoff_s=0.0)
+    assert calls["n"] == 3

@@ -189,3 +189,52 @@ def test_cli_unknown_model_lists_choices():
             "--direction", "hf-to-native", "--model", "nope",
             "--input", "/tmp/x", "--output", "/tmp/y",
         ])
+
+
+def test_generate_cli_arg_validation():
+    """examples/generate.py argument paths: unknown model lists choices,
+    BERT is refused by the decode dispatcher, missing prompt errors, and
+    malformed --prompt-ids fail rather than generate garbage."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "examples", "generate.py")
+    env = dict(os.environ)
+    # subprocesses must not touch the real-chip backend: force cpu AND strip
+    # the axon sitecustomize (its register() call can block on a dead relay
+    # before JAX_PLATFORMS is ever consulted)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    ) or os.getcwd()
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, script, *args],
+            capture_output=True, text=True, env=env, timeout=240,
+        )
+
+    r = run("--model", "nope", "--random-init", "--prompt-ids", "1,2")
+    assert r.returncode != 0 and "tiny-neox" in (r.stderr + r.stdout)
+
+    r = run(
+        "--model", "tiny-bert", "--random-init", "--prompt-ids", "1,2",
+        "--cpu-devices", "2",
+    )
+    assert r.returncode != 0
+    assert "bidirectional" in (r.stderr + r.stdout)
+
+    r = run("--model", "tiny", "--random-init", "--cpu-devices", "2")
+    assert r.returncode != 0
+    assert "--prompt" in (r.stderr + r.stdout)
+
+    r = run(
+        "--model", "tiny", "--random-init", "--prompt-ids", "1,a,2",
+        "--cpu-devices", "2",
+    )
+    assert r.returncode != 0  # malformed ids must not silently generate
+
