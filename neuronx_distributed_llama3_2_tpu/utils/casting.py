@@ -1,0 +1,59 @@
+"""Parameter-tree dtype casting.
+
+The role of the reference's autocast helpers (parallel_layers/utils.py:
+164-210 cast wrappers + the inference DecoderModelInstance cast rule,
+model_wrapper.py:303: "float32 → config dtype except lm_head/rmsnorm") and
+of ``XLA_DOWNCAST_BF16``-style global downcasts — done explicitly on the
+pytree instead of ambiently.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+#: parameters kept in fp32 under a downcast: norm scales/biases and the
+#: LM head (the reference's DecoderModelInstance exception list,
+#: model_wrapper.py:303). Tied-embedding models have no lm_head leaf — the
+#: shared table follows the embedding cast.
+DEFAULT_KEEP_FP32 = (
+    r"norm/(scale|bias)$",
+    r"lm_head/",
+    r"mlm_bias$",
+)
+
+
+def cast_params(
+    params: Params,
+    dtype: Any = jnp.bfloat16,
+    keep_fp32: Tuple[str, ...] = DEFAULT_KEEP_FP32,
+) -> Params:
+    """Cast floating-point leaves to ``dtype``, keeping fp32 where the
+    '/'-joined path matches ``keep_fp32`` (norm weights by default) and
+    leaving integer/bool leaves and QuantizedTensor nodes untouched (an
+    int8 payload must keep its fp32 scale — downcasting the scale would put
+    ~bf16-mantissa error on every dequantized weight)."""
+    from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
+        QuantizedTensor,
+    )
+
+    def visit(path, leaf):
+        if isinstance(leaf, QuantizedTensor):
+            return leaf
+        key = "/".join(str(getattr(k, "key", getattr(k, "name", k))) for k in path)
+        if not isinstance(leaf, (jax.Array,)) and not hasattr(leaf, "dtype"):
+            return leaf
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        if any(re.search(p, key) for p in keep_fp32):
+            return leaf.astype(jnp.float32)
+        return leaf.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(
+        visit, params, is_leaf=lambda l: isinstance(l, QuantizedTensor)
+    )

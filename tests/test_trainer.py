@@ -205,3 +205,42 @@ def test_eval_step_matches_loss_and_no_param_change():
         np.testing.assert_allclose(mean, want, rtol=1e-6)
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_cast_params_downcast_keeps_norms_fp32():
+    """utils.casting.cast_params: the reference's float32→bf16 serving cast
+    with the lm-head/norm fp32 exception list (model_wrapper.py:303)."""
+    import dataclasses
+
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+    )
+    from neuronx_distributed_llama3_2_tpu.utils.casting import cast_params
+
+    # untied config so the lm_head exception is actually exercised
+    cfg = dataclasses.replace(LLAMA_CONFIGS["tiny"], tie_word_embeddings=False)
+    params = LlamaForCausalLM(cfg).init(jax.random.key(0))
+    cast = cast_params(params, jnp.bfloat16)
+    # norms + lm head stay fp32 (the reference exception list)
+    assert cast["final_norm"]["scale"].dtype == jnp.float32
+    assert cast["layers"]["attn_norm"]["scale"].dtype == jnp.float32
+    assert cast["lm_head"]["kernel"].dtype == jnp.float32
+    # matmul weights downcast
+    assert cast["layers"]["attn"]["qkv"]["q_kernel"].dtype == jnp.bfloat16
+    assert cast["embed"]["embedding"].dtype == jnp.bfloat16
+    # bf16 model runs with the cast tree
+    bf_cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16, tie_word_embeddings=False)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 8)), jnp.int32
+    )
+    out = LlamaForCausalLM(bf_cfg)(cast, ids)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    # int8 quantized payloads untouched
+    from neuronx_distributed_llama3_2_tpu.quantization import quantize_params
+
+    q = cast_params(quantize_params(params), jnp.bfloat16)
+    assert q["layers"]["attn"]["qkv"]["q_kernel"].qvalue.dtype == jnp.int8
+    # the dequant scale must STAY fp32 (a bf16 scale would smear ~0.4%
+    # relative error over every dequantized weight)
+    assert q["layers"]["attn"]["qkv"]["q_kernel"].scale.dtype == jnp.float32
