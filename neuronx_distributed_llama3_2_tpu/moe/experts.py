@@ -94,11 +94,18 @@ class ExpertMLPs:
         t = x.shape[0]
         xb = jnp.broadcast_to(x, (self.num_experts, t, x.shape[1]))
         y_all = self._mlp(params, xb)  # (E, T, H)
-        # combine: for each token, sum over its k chosen experts
-        combine = jnp.zeros((t, self.num_experts), jnp.float32)
-        combine = combine.at[
-            jnp.arange(t)[:, None], idx
-        ].add(gates)  # (T, E)
+        # combine: for each token, sum over its k chosen experts. Built as a
+        # compare-to-iota one-hot einsum, NOT a scatter-add: scatters with
+        # data-dependent indices inside a partial-manual shard_map region
+        # (the 1F1B pp executor) trip an XLA SPMD partitioner CHECK
+        # (spmd_partitioner_util.cc:495, replica-group derivation — see
+        # docs/moe_1f1b_tp.md for the minimal repro), and dense one-hot
+        # contractions are the MXU-friendly formulation anyway (same trick
+        # as the reference's top-k one-hot in moe/loss_function.py:5).
+        onehot = (
+            idx[:, :, None] == jnp.arange(self.num_experts, dtype=idx.dtype)
+        ).astype(jnp.float32)  # (T, k, E)
+        combine = jnp.einsum("tke,tk->te", onehot, gates)  # (T, E)
         return jnp.einsum(
             "te,eth->th", combine.astype(x.dtype), y_all
         )

@@ -83,33 +83,6 @@ class PipelinedCausalLM:
             raise ValueError(
                 f"schedule must be one of {SCHEDULES}, got {self.schedule!r}"
             )
-        self._check_moe_1f1b_mesh()
-
-    def _check_moe_1f1b_mesh(self, executing: bool = False) -> None:
-        """MoE 1F1B supports pp x dp only: the expert-einsum transposes (and
-        EP all-to-alls) inside the pp-manual VJP region make XLA's SPMD
-        partitioner derive inconsistent replica groups under tp/ep and die
-        on a CHECK (spmd_partitioner_util.cc:495) — a process abort, so
-        validate here and again at loss_and_grad (construction may predate
-        the mesh)."""
-        # ``executing`` = called from loss_and_grad itself, which always
-        # runs the 1F1B executor no matter what schedule= says — the mesh
-        # check must not be skippable by constructing with schedule='gpipe'
-        if not self._is_moe():
-            return
-        if not (executing or self.schedule == "1f1b"):
-            return
-        if not parallel_state.model_parallel_is_initialized():
-            return
-        if (
-            parallel_state.get_tensor_model_parallel_size() > 1
-            or parallel_state.get_expert_model_parallel_size() > 1
-        ):
-            raise ValueError(
-                "MoE + schedule='1f1b' supports pp x dp meshes only (XLA "
-                "SPMD partitioner limitation under tp/ep inside the manual "
-                "VJP region); use schedule='gpipe' for MoE with tp or ep > 1"
-            )
 
     def _is_moe(self) -> bool:
         from neuronx_distributed_llama3_2_tpu.models.mixtral import (
@@ -348,7 +321,6 @@ class PipelinedCausalLM:
         program on its own (mostly discarded) data — wasted flops worth
         head/(head+stage) per rotation; pick gpipe when memory allows.
         """
-        self._check_moe_1f1b_mesh(executing=True)
         cfg = self.config
         pp, M = self._pp(), self.num_microbatches
         gbs, S = input_ids.shape
