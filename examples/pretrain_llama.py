@@ -41,6 +41,15 @@ def parse_args():
     p.add_argument("--sp", action="store_true", help="sequence parallelism")
     p.add_argument("--global-batch", type=int, default=8)
     p.add_argument("--microbatches", type=int, default=1)
+    p.add_argument(
+        "--pp-schedule", default="gpipe",
+        choices=["gpipe", "1f1b", "interleaved"],
+        help="pipeline executor (docs/interleaved_vpp.md for tradeoffs)",
+    )
+    p.add_argument(
+        "--model-chunks", type=int, default=1,
+        help="interleaved VPP chunks per pp lane (--pp-schedule interleaved)",
+    )
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=3e-4)
@@ -168,6 +177,8 @@ def main():
     config = TrainingConfig(
         tensor_parallel_size=args.tp,
         pipeline_parallel_size=args.pp,
+        pipeline_schedule=args.pp_schedule,
+        num_model_chunks=args.model_chunks,
         expert_parallel_size=args.ep,
         sequence_parallel=args.sp,
         # under pp the pipelined model does its own microbatching; the
@@ -185,7 +196,12 @@ def main():
     base_model = entry["model_cls"](model_cfg)
     pipelined = args.pp > 1
     model = (
-        PipelinedCausalLM(base_model, num_microbatches=max(args.microbatches, args.pp))
+        PipelinedCausalLM(
+            base_model,
+            num_microbatches=max(args.microbatches, args.pp),
+            schedule=args.pp_schedule,
+            num_model_chunks=args.model_chunks,
+        )
         if pipelined
         else base_model
     )
@@ -428,7 +444,11 @@ def main():
             if metrics_file:
                 metrics_file.log(step, eval_loss=ev_loss)
             throughput.reset()  # eval wall time must not read as a dip
-        if (step + 1) % args.save_every == 0 and step + 1 < args.steps:
+        if (
+            args.save_every > 0
+            and (step + 1) % args.save_every == 0
+            and step + 1 < args.steps
+        ):
             with timeline.event("save_checkpoint", cat="ckpt", step=step + 1):
                 save(step + 1)
             throughput.reset()  # blocking save time isn't training time

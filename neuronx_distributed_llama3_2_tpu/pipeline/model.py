@@ -14,7 +14,8 @@ task (model.py:1382). Under single-program SPMD all of that collapses to:
 - **p2p** = ``jnp.roll`` of the pp-sharded microbatch stream, which XLA
   lowers to a neighbor ``collective-permute`` over ICI — real p2p, not the
   all-gather trick (SURVEY.md §5 backend note);
-- **schedule** = one ``lax.scan`` over the rotation count. Two executors:
+- **schedule** = one ``lax.scan`` over the rotation count (or an unrolled
+  static rotation plan). Three executors:
   ``schedule="gpipe"`` scans ``M + pp - 1`` forward rotations
   (:class:`..pipeline.scheduler.TrainGPipeSchedule`) and lets autodiff run
   the backward pipeline in reverse — O(M) stored rotation streams;
@@ -22,6 +23,8 @@ task (model.py:1382). Under single-program SPMD all of that collapses to:
   :class:`..pipeline.scheduler.Train1F1BSchedule`'s timing with a manual
   per-stage VJP inside a single scan — activation stash bounded O(pp)
   (measured: 284MB vs 480MB at pp=4, M=32, and M-independent);
+  ``schedule="interleaved"`` executes Megatron virtual-pipeline chunking
+  as a static chunked-rotation plan (docs/interleaved_vpp.md);
 - **shared embedding** (tied embeddings used by stage 0 and the head) needs
   no grad-sync machinery (reference ``analyze_shared_weights_across_stages``
   partition.py:232 / ``_reduce_shared_weights`` model.py:620): it is one
@@ -51,7 +54,7 @@ from neuronx_distributed_llama3_2_tpu.parallel.state import PP_AXIS, TP_AXIS
 
 Params = Dict[str, Any]
 
-SCHEDULES = ("gpipe", "1f1b")
+SCHEDULES = ("gpipe", "1f1b", "interleaved")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,6 +75,11 @@ class PipelinedCausalLM:
     #   tradeoff the reference's Train1F1BSchedule exists for
     #   (pipeline/scheduler.py:157).
     schedule: str = "gpipe"
+    # "interleaved" only: virtual-pipeline model chunks per lane (Megatron
+    # VPP, reference scheduler.py:256). Executed as a chunked SPMD rotation
+    # following scheduler.InterleavedRotationPlan — measured tradeoffs in
+    # docs/interleaved_vpp.md.
+    num_model_chunks: int = 1
 
     def __post_init__(self):
         if not (isinstance(self.model, LlamaForCausalLM) or self._is_moe()):
@@ -82,6 +90,14 @@ class PipelinedCausalLM:
         if self.schedule not in SCHEDULES:
             raise ValueError(
                 f"schedule must be one of {SCHEDULES}, got {self.schedule!r}"
+            )
+        if self.num_model_chunks < 1:
+            raise ValueError(
+                f"num_model_chunks must be >= 1, got {self.num_model_chunks}"
+            )
+        if self.num_model_chunks > 1 and self.schedule != "interleaved":
+            raise ValueError(
+                "num_model_chunks > 1 requires schedule='interleaved'"
             )
 
     def _is_moe(self) -> bool:
@@ -100,28 +116,42 @@ class PipelinedCausalLM:
 
     def _layers_per_stage(self) -> int:
         L, pp = self.config.num_layers, self._pp()
-        if L % pp != 0:
-            raise ValueError(f"num_layers {L} not divisible by pp {pp}")
-        return L // pp
+        v = self.num_model_chunks
+        if L % (pp * v) != 0:
+            raise ValueError(
+                f"num_layers {L} not divisible by pp*chunks {pp}*{v}"
+            )
+        return L // (pp * v)
 
     # -- parameter layout ------------------------------------------------
 
     def to_pipeline(self, params: Params) -> Params:
         """(L, ...) stacked layers → (pp, L/pp, ...). Stage s owns layers
         [s·L/pp, (s+1)·L/pp) — the reference's even auto-partition
-        (partition.py:280, model.py:306-318)."""
+        (partition.py:280, model.py:306-318).
+
+        schedule="interleaved": → (V, pp, L/(pp·V), ...) where lane s's
+        chunk v is the contiguous layer block of virtual stage u = v·pp + s
+        (Megatron chunk assignment, reference scheduler.py:319-353)."""
         pp, lps = self._pp(), self._layers_per_stage()
         out = dict(params)
-        out["layers"] = jax.tree.map(
-            lambda p: p.reshape(pp, lps, *p.shape[1:]), params["layers"]
-        )
+        if self.schedule == "interleaved":
+            v = self.num_model_chunks
+            out["layers"] = jax.tree.map(
+                lambda p: p.reshape(v, pp, lps, *p.shape[1:]), params["layers"]
+            )
+        else:
+            out["layers"] = jax.tree.map(
+                lambda p: p.reshape(pp, lps, *p.shape[1:]), params["layers"]
+            )
         return out
 
     def from_pipeline(self, params: Params) -> Params:
         L = self.config.num_layers
+        skip = 3 if self.schedule == "interleaved" else 2
         out = dict(params)
         out["layers"] = jax.tree.map(
-            lambda p: p.reshape(L, *p.shape[2:]), params["layers"]
+            lambda p: p.reshape(L, *p.shape[skip:]), params["layers"]
         )
         return out
 
@@ -132,12 +162,20 @@ class PipelinedCausalLM:
         base = self.model.specs()
         out = dict(base)
         # layer leaves are P(None, *per-layer); pipeline adds the pp axis on
-        # the stage dim: P("pp", None, *per-layer)
-        out["layers"] = jax.tree.map(
-            lambda s: P(PP_AXIS, *s),
-            base["layers"],
-            is_leaf=lambda s: isinstance(s, P),
-        )
+        # the stage dim: P("pp", None, *per-layer) — or, interleaved,
+        # P(None, "pp", None, *per-layer) for the (V, pp, Lv, ...) layout
+        if self.schedule == "interleaved":
+            out["layers"] = jax.tree.map(
+                lambda s: P(None, PP_AXIS, *s),
+                base["layers"],
+                is_leaf=lambda s: isinstance(s, P),
+            )
+        else:
+            out["layers"] = jax.tree.map(
+                lambda s: P(PP_AXIS, *s),
+                base["layers"],
+                is_leaf=lambda s: isinstance(s, P),
+            )
         return out
 
     # -- execution -------------------------------------------------------
@@ -254,14 +292,145 @@ class PipelinedCausalLM:
         # every (stage, microbatch) pair contributed its stage-mean aux once
         return hidden, aux_sum / (pp * M)
 
+    def _interleaved_hidden(
+        self, params: Params, input_ids: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Chunked SPMD rotation realizing interleaved VPP (reference
+        ``TrainInterleavedSchedule`` scheduler.py:256): each lane owns
+        ``V = num_model_chunks`` virtual stages of ``L/(pp·V)`` layers, and
+        every rotation executes one virtual stage per lane following the
+        static host-simulated :class:`..pipeline.scheduler
+        .InterleavedRotationPlan` (admission stalls resolved
+        oldest-hop-first). The stream's neighbor ppermute is unchanged —
+        virtual stage u → u+1 is always lane s → s+1 — so interleaving
+        costs no new collective patterns, only more rotations of shorter
+        stages. Measured tradeoffs vs gpipe/1F1B: docs/interleaved_vpp.md.
+
+        Forward-only plan; backward is autodiff through the unrolled
+        rotations (gpipe-memory-profile). Returns (hidden (B,S,H),
+        mean router aux)."""
+        cfg = self.config
+        pp, M, V = self._pp(), self.num_microbatches, self.num_model_chunks
+        gbs, S = input_ids.shape
+        if gbs % M != 0:
+            raise ValueError(f"batch {gbs} not divisible by microbatches {M}")
+        mbs = gbs // M
+        H = cfg.hidden_size
+        mesh = parallel_state.get_parallel_state().mesh
+
+        from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (
+            InterleavedRotationPlan,
+        )
+
+        plan = InterleavedRotationPlan(M, V, pp)
+
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mbs, S))
+        sin, cos = self.model._rope(S)
+        x = self.model._embed()(params["embed"], input_ids)  # (GBS, S, H)
+        x_mb = x.reshape(mbs, M, S, -1).swapaxes(0, 1)  # (M, mbs, S, H)
+        x_mb = constrain(x_mb, P(None, BATCH_AXES, None, None))
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def lane_body(layers_l, x_all):
+            # pp-manual leaves arrive (V, 1, Lv, ...); drop the lane dim
+            layers_lane = jax.tree.map(lambda p: p[:, 0], layers_l)
+            s = lax.axis_index(PP_AXIS)
+            slots = jnp.zeros((V, mbs, S, H), cfg.dtype)
+            out_buf = jnp.zeros((M, mbs, S, H), cfg.dtype)
+            aux_sum = jnp.float32(0.0)
+            for step in plan.steps_:
+                chunk_a = jnp.asarray(step.chunk, jnp.int32)[s]
+                mb_a = jnp.asarray(step.mb, jnp.int32)[s]
+                admit_a = jnp.asarray(step.admit, jnp.int32)[s]
+                # receiver-side routing: lane d's inbound stream comes from
+                # lane d-1 and lands in the chunk slot the sender computed
+                in_slot = jnp.asarray(
+                    [step.out_slot[(d - 1) % pp] for d in range(pp)],
+                    jnp.int32,
+                )[s]
+                # a stream exits when its output is not stored anywhere
+                # (out_slot -1) while the lane ran a real microbatch
+                exits = jnp.asarray(
+                    [
+                        1 if (step.out_slot[d] == -1 and step.mb[d] >= 0) else 0
+                        for d in range(pp)
+                    ],
+                    jnp.int32,
+                )[s]
+
+                c_cl = jnp.clip(chunk_a, 0, V - 1)
+                x_slot = lax.dynamic_index_in_dim(
+                    slots, c_cl, axis=0, keepdims=False
+                )
+                x_fresh = lax.dynamic_index_in_dim(
+                    x_all, jnp.clip(admit_a, 0, M - 1), axis=0, keepdims=False
+                ).astype(cfg.dtype)
+                x_in = jnp.where(admit_a >= 0, x_fresh, x_slot)
+                stage_layers = jax.tree.map(
+                    lambda p: lax.dynamic_index_in_dim(
+                        p, c_cl, axis=0, keepdims=False
+                    ),
+                    layers_lane,
+                )
+                y, aux = self._scan_stage(
+                    stage_layers, x_in, sin, cos, positions
+                )
+                y = y.astype(cfg.dtype)
+                aux_sum = aux_sum + jnp.where(
+                    mb_a >= 0, aux.astype(jnp.float32), 0.0
+                )
+                # collect exiting microbatches (only lane pp-1 ever exits:
+                # the last virtual stage pp·V-1 ≡ pp-1 mod pp)
+                m_cl = jnp.clip(mb_a, 0, M - 1)
+                cur = lax.dynamic_index_in_dim(
+                    out_buf, m_cl, axis=0, keepdims=False
+                )
+                out_buf = lax.dynamic_update_index_in_dim(
+                    out_buf, jnp.where(exits > 0, y, cur), m_cl, axis=0
+                )
+                # rotate; park the inbound stream in its chunk slot
+                recv = lax.ppermute(y, PP_AXIS, fwd_perm)
+                in_cl = jnp.clip(in_slot, 0, V - 1)
+                cur_slot = lax.dynamic_index_in_dim(
+                    slots, in_cl, axis=0, keepdims=False
+                )
+                slots = lax.dynamic_update_index_in_dim(
+                    slots, jnp.where(in_slot >= 0, recv, cur_slot), in_cl, axis=0
+                )
+            return out_buf[None], aux_sum[None]
+
+        layer_specs = jax.tree.map(lambda _: P(None, PP_AXIS), params["layers"])
+        out_buf, aux_lanes = jax.shard_map(
+            lane_body,
+            mesh=mesh,
+            in_specs=(layer_specs, P()),
+            out_specs=(P(PP_AXIS), P(PP_AXIS)),
+            axis_names={PP_AXIS},
+            check_vma=False,
+        )(params["layers"], x_mb)
+
+        hidden_mb = out_buf[pp - 1]  # (M, mbs, S, H) — exits live on lane pp-1
+        hidden = hidden_mb.swapaxes(0, 1).reshape(gbs, S, -1)
+        hidden = self.model._norm()(params["final_norm"], hidden)
+        # every (virtual stage, microbatch) visit contributed its chunk-mean
+        # aux once; stages have equal layer counts so this equals the global
+        # per-(layer, microbatch) mean the other executors compute
+        aux = jnp.sum(aux_lanes) / (pp * V * M)
+        return hidden, aux
+
+    def _hidden(self, params: Params, input_ids: jax.Array):
+        if self.schedule == "interleaved":
+            return self._interleaved_hidden(params, input_ids)
+        return self._pipeline_hidden(params, input_ids)
+
     def __call__(self, params: Params, input_ids: jax.Array) -> jax.Array:
-        hidden, _ = self._pipeline_hidden(params, input_ids)
+        hidden, _ = self._hidden(params, input_ids)
         return self.model._logits(params, hidden)
 
     def loss(
         self, params: Params, input_ids: jax.Array, labels: jax.Array
     ) -> jax.Array:
-        hidden, aux = self._pipeline_hidden(params, input_ids)
+        hidden, aux = self._hidden(params, input_ids)
         ce = self.model.loss_from_hidden(params, hidden, labels)
         if self._is_moe():
             # per-(layer, microbatch) aux mean — the microbatched analogue of
@@ -321,6 +490,13 @@ class PipelinedCausalLM:
         program on its own (mostly discarded) data — wasted flops worth
         head/(head+stage) per rotation; pick gpipe when memory allows.
         """
+        if self.schedule == "interleaved":
+            # the (V, pp, Lv, ...) chunk layout is not the 1F1B stream
+            # layout; interleaved backward runs via autodiff on loss()
+            raise ValueError(
+                "loss_and_grad is the 1F1B executor; schedule='interleaved' "
+                "differentiates loss() (autodiff backward)"
+            )
         cfg = self.config
         pp, M = self._pp(), self.num_microbatches
         gbs, S = input_ids.shape

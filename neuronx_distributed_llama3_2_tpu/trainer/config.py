@@ -82,6 +82,13 @@ class TrainingConfig:
     # per-step global batch is split into this many sequential microbatches
     # (reference grad-accum loop, tp_zero1_llama_hf_pretrain.py:277-350)
     num_microbatches: int = 1
+    # pipeline executor for pp > 1 (pipeline/model.py SCHEDULES); reference
+    # pipeline_config {"scheduler", "virtual_pipeline_size"} knobs
+    pipeline_schedule: str = "gpipe"
+    # interleaved VPP chunks per pp lane (reference TrainInterleavedSchedule
+    # scheduler.py:256); >1 requires pipeline_schedule="interleaved" —
+    # measured tradeoffs in docs/interleaved_vpp.md
+    num_model_chunks: int = 1
     seed: int = 42
 
     def initialize(self, devices=None) -> parallel_state.ParallelState:

@@ -508,3 +508,158 @@ def test_moe_1f1b_tp_ep_matches_gpipe(tp, ep):
             )
     finally:
         parallel_state.destroy_model_parallel()
+
+
+# ---------------------------------------------------------------------------
+# Interleaved VPP: rotation plan invariants + SPMD executor parity
+# (docs/interleaved_vpp.md)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pp,chunks,mb", [(2, 2, 4), (4, 2, 16), (4, 4, 8),
+                                          (3, 2, 6), (2, 3, 5)])
+def test_rotation_plan_invariants(pp, chunks, mb):
+    """The host-simulated chunked-rotation plan conserves work (built-in
+    assert), exits only on the last lane, admits each microbatch once on
+    lane 0, and routes every active output to a consistent receiver slot."""
+    from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (
+        InterleavedRotationPlan,
+    )
+
+    plan = InterleavedRotationPlan(mb, chunks, pp)
+    admitted = []
+    executed = []
+    for step in plan.steps_:
+        for s in range(pp):
+            if step.admit[s] >= 0:
+                assert s == 0  # fresh microbatches enter lane 0 only
+                admitted.append(step.admit[s])
+            if step.mb[s] >= 0:
+                executed.append((step.mb[s], step.chunk[s], s))
+            # exits only from the final virtual stage's lane
+            if step.out_slot[s] == -1 and step.mb[s] >= 0:
+                assert s == pp - 1 and step.chunk[s] == chunks - 1
+    assert admitted == list(range(mb))
+    # every (mb, chunk, lane) virtual-stage visit happens exactly once
+    want = {(m, c, s) for m in range(mb) for c in range(chunks)
+            for s in range(pp)}
+    assert set(executed) == want and len(executed) == len(want)
+
+
+def test_rotation_plan_v1_matches_gpipe_rotation_count():
+    from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (
+        InterleavedRotationPlan,
+    )
+
+    for pp, mb in [(2, 4), (4, 16), (8, 32)]:
+        assert InterleavedRotationPlan(mb, 1, pp).num_rotations == mb + pp - 1
+
+
+def test_rotation_plan_bubble_shrinks_with_chunks():
+    """The lock-step cost model: idle lane-rotations are constant in V while
+    per-rotation stage length shrinks 1/V — chunking strictly reduces
+    lock-step bubble waste (the round-2 docstring claimed the opposite; the
+    measured table lives in docs/interleaved_vpp.md)."""
+    from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (
+        InterleavedRotationPlan,
+    )
+
+    L_per_lane = 8
+    units = {
+        V: InterleavedRotationPlan(16, V, 4).cost_model(L_per_lane)[0]
+        for V in (1, 2, 4)
+    }
+    assert units[2] < units[1] and units[4] < units[2]
+
+
+@pytest.mark.parametrize("pp,V,M", [(2, 2, 4), (2, 2, 6)])
+def test_interleaved_executor_matches_unpipelined(pp, V, M):
+    """Chunked-rotation executor: loss == unpipelined model, grads finite
+    and matching gpipe's."""
+    model = LlamaForCausalLM(TINY)
+    params = model.init(jax.random.key(1))
+    gbs = 2 * M
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, TINY.vocab_size, (gbs, 16)),
+        jnp.int32,
+    )
+    ref = float(jax.jit(model.loss)(params, ids, ids))
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(pipeline_model_parallel_size=pp)
+    try:
+        pm = PipelinedCausalLM(
+            model, num_microbatches=M, schedule="interleaved",
+            num_model_chunks=V,
+        )
+        pv = shard_pytree(pm.to_pipeline(params), pm.specs())
+        loss, grads = jax.jit(jax.value_and_grad(pm.loss))(pv, ids, ids)
+        assert abs(float(loss) - ref) < 2e-3, (float(loss), ref)
+
+        gp = PipelinedCausalLM(model, num_microbatches=M, schedule="gpipe")
+        gv = shard_pytree(gp.to_pipeline(params), gp.specs())
+        _, ref_grads = jax.jit(jax.value_and_grad(gp.loss))(gv, ids, ids)
+        got = pm.from_pipeline(grads)
+        want = gp.from_pipeline(ref_grads)
+        from neuronx_distributed_llama3_2_tpu.checkpoint.checkpoint import (
+            _flatten,
+        )
+
+        fg, fw = _flatten(got), _flatten(want)
+        assert set(fg) == set(fw)
+        for k in fw:
+            np.testing.assert_allclose(
+                np.asarray(fg[k], np.float32), np.asarray(fw[k], np.float32),
+                atol=5e-4, rtol=1e-3, err_msg=k,
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_interleaved_rejects_chunks_on_other_schedules():
+    model = LlamaForCausalLM(TINY)
+    with pytest.raises(ValueError, match="interleaved"):
+        PipelinedCausalLM(model, num_microbatches=2, schedule="gpipe",
+                          num_model_chunks=2)
+
+
+def test_interleaved_loss_and_grad_refused():
+    model = LlamaForCausalLM(TINY)
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(pipeline_model_parallel_size=2)
+    try:
+        pm = PipelinedCausalLM(
+            model, num_microbatches=2, schedule="interleaved",
+            num_model_chunks=2,
+        )
+        ids = jnp.zeros((4, 8), jnp.int32)
+        pv = shard_pytree(pm.to_pipeline(model.init(jax.random.key(0))),
+                          pm.specs())
+        with pytest.raises(ValueError, match="autodiff"):
+            pm.loss_and_grad(pv, ids, ids)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_interleaved_via_pretrain_cli(tmp_path):
+    """TrainingConfig/CLI wiring (VERDICT r2 item 3): the pretrain example
+    runs the interleaved executor end-to-end via --pp-schedule interleaved
+    --model-chunks 2."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(repo, "examples", "pretrain_llama.py"),
+            "--model", "tiny", "--cpu-devices", "4", "--pp", "2",
+            "--pp-schedule", "interleaved", "--model-chunks", "2",
+            "--microbatches", "2", "--global-batch", "4", "--seq-len", "32",
+            "--synthetic", "20000", "--steps", "3",
+            "--ckpt-dir", str(tmp_path / "ckpt"), "--save-every", "0",
+            "--metrics-file", str(tmp_path / "m.jsonl"),
+        ],
+        capture_output=True, text=True, timeout=480,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "done: 3 steps" in r.stderr
