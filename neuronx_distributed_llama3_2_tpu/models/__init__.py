@@ -8,18 +8,21 @@ from neuronx_distributed_llama3_2_tpu.models.mixtral import (  # noqa: F401
     MixtralConfig,
     MixtralForCausalLM,
     params_from_hf_mixtral,
+    params_to_hf_mixtral,
 )
 from neuronx_distributed_llama3_2_tpu.models.dbrx import (  # noqa: F401
     DBRX_CONFIGS,
     DbrxConfig,
     DbrxForCausalLM,
     params_from_hf_dbrx,
+    params_to_hf_dbrx,
 )
 from neuronx_distributed_llama3_2_tpu.models.bert import (  # noqa: F401
     BERT_CONFIGS,
     BertConfig,
     BertForPreTraining,
     params_from_hf_bert,
+    params_to_hf_bert,
 )
 from neuronx_distributed_llama3_2_tpu.models.gptneox import (  # noqa: F401
     GPTNEOX_CONFIGS,
@@ -27,6 +30,8 @@ from neuronx_distributed_llama3_2_tpu.models.gptneox import (  # noqa: F401
     GPTNeoXForCausalLM,
     params_from_hf_codegen,
     params_from_hf_neox,
+    params_to_hf_codegen,
+    params_to_hf_neox,
 )
 from neuronx_distributed_llama3_2_tpu.models.mllama import (  # noqa: F401
     MllamaConfig,
@@ -55,12 +60,12 @@ def model_registry():
     for name, cfg in MIXTRAL_CONFIGS.items():
         reg[name] = {
             "config": cfg, "model_cls": MixtralForCausalLM,
-            "from_hf": params_from_hf_mixtral, "to_hf": None,
+            "from_hf": params_from_hf_mixtral, "to_hf": params_to_hf_mixtral,
         }
     for name, cfg in DBRX_CONFIGS.items():
         reg[name] = {
             "config": cfg, "model_cls": DbrxForCausalLM,
-            "from_hf": params_from_hf_dbrx, "to_hf": None,
+            "from_hf": params_from_hf_dbrx, "to_hf": params_to_hf_dbrx,
         }
     for name, cfg in GPTNEOX_CONFIGS.items():
         reg[name] = {
@@ -69,12 +74,15 @@ def model_registry():
                 params_from_hf_codegen if cfg.rotary_interleaved
                 else params_from_hf_neox
             ),
-            "to_hf": None,
+            "to_hf": (
+                params_to_hf_codegen if cfg.rotary_interleaved
+                else params_to_hf_neox
+            ),
         }
     for name, cfg in BERT_CONFIGS.items():
         reg[name] = {
             "config": cfg, "model_cls": BertForPreTraining,
-            "from_hf": params_from_hf_bert, "to_hf": None,
+            "from_hf": params_from_hf_bert, "to_hf": params_to_hf_bert,
         }
     return reg
 

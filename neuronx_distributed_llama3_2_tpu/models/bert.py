@@ -483,3 +483,75 @@ def params_from_hf_bert(state_dict: Dict[str, Any], config: BertConfig) -> Param
             "bias": jnp.asarray(t("cls.seq_relationship.bias"), dt),
         },
     }
+
+
+def params_to_hf_bert(params: Params, config: BertConfig) -> Dict[str, Any]:
+    """Inverse of :func:`params_from_hf_bert`: stacked pytree → HF
+    ``BertForPreTraining`` state dict. Native→HF direction of the
+    reference's family-generic converter (scripts/checkpoint_converter.py
+    :685); the tied MLM decoder weight is emitted from the word embedding
+    like HF does."""
+    import numpy as np
+
+    c = config
+    L = c.num_layers
+
+    def np32(x):
+        return np.asarray(x, dtype=np.float32)
+
+    emb = params["embeddings"]
+    lyr = params["layers"]
+    word = np32(emb["word"]["embedding"])
+    sd: Dict[str, Any] = {
+        "bert.embeddings.word_embeddings.weight": word,
+        "bert.embeddings.position_embeddings.weight": np32(emb["position"]),
+        "bert.embeddings.token_type_embeddings.weight": np32(emb["token_type"]),
+        "bert.embeddings.LayerNorm.weight": np32(emb["norm"]["scale"]),
+        "bert.embeddings.LayerNorm.bias": np32(emb["norm"]["bias"]),
+        "bert.pooler.dense.weight": np32(params["pooler"]["kernel"]).T,
+        "bert.pooler.dense.bias": np32(params["pooler"]["bias"]),
+        "cls.predictions.transform.dense.weight": np32(
+            params["mlm_transform"]["kernel"]
+        ).T,
+        "cls.predictions.transform.dense.bias": np32(
+            params["mlm_transform"]["bias"]
+        ),
+        "cls.predictions.transform.LayerNorm.weight": np32(
+            params["mlm_norm"]["scale"]
+        ),
+        "cls.predictions.transform.LayerNorm.bias": np32(
+            params["mlm_norm"]["bias"]
+        ),
+        "cls.predictions.bias": np32(params["mlm_bias"]),
+        "cls.predictions.decoder.weight": word,  # tied
+        "cls.predictions.decoder.bias": np32(params["mlm_bias"]),
+        "cls.seq_relationship.weight": np32(params["nsp"]["kernel"]).T,
+        "cls.seq_relationship.bias": np32(params["nsp"]["bias"]),
+    }
+    qkv = lyr["attn"]["qkv"]
+    q_k, k_k, v_k = np32(qkv["q_kernel"]), np32(qkv["k_kernel"]), np32(qkv["v_kernel"])
+    q_b, k_b, v_b = np32(qkv["q_bias"]), np32(qkv["k_bias"]), np32(qkv["v_bias"])
+    o_k, o_b = np32(lyr["attn"]["o"]["kernel"]), np32(lyr["attn"]["o"]["bias"])
+    an_w, an_b = np32(lyr["attn_norm"]["scale"]), np32(lyr["attn_norm"]["bias"])
+    up_k, up_b = np32(lyr["mlp"]["up"]["kernel"]), np32(lyr["mlp"]["up"]["bias"])
+    dn_k, dn_b = np32(lyr["mlp"]["down"]["kernel"]), np32(lyr["mlp"]["down"]["bias"])
+    mn_w, mn_b = np32(lyr["mlp_norm"]["scale"]), np32(lyr["mlp_norm"]["bias"])
+    for i in range(L):
+        pre = f"bert.encoder.layer.{i}."
+        sd[pre + "attention.self.query.weight"] = q_k[i].T
+        sd[pre + "attention.self.key.weight"] = k_k[i].T
+        sd[pre + "attention.self.value.weight"] = v_k[i].T
+        sd[pre + "attention.self.query.bias"] = q_b[i]
+        sd[pre + "attention.self.key.bias"] = k_b[i]
+        sd[pre + "attention.self.value.bias"] = v_b[i]
+        sd[pre + "attention.output.dense.weight"] = o_k[i].T
+        sd[pre + "attention.output.dense.bias"] = o_b[i]
+        sd[pre + "attention.output.LayerNorm.weight"] = an_w[i]
+        sd[pre + "attention.output.LayerNorm.bias"] = an_b[i]
+        sd[pre + "intermediate.dense.weight"] = up_k[i].T
+        sd[pre + "intermediate.dense.bias"] = up_b[i]
+        sd[pre + "output.dense.weight"] = dn_k[i].T
+        sd[pre + "output.dense.bias"] = dn_b[i]
+        sd[pre + "output.LayerNorm.weight"] = mn_w[i]
+        sd[pre + "output.LayerNorm.bias"] = mn_b[i]
+    return sd

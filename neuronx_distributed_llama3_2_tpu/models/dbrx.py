@@ -141,3 +141,52 @@ def params_from_hf_dbrx(state_dict: Dict[str, Any], config: DbrxConfig) -> Param
     if not c.tie_word_embeddings:
         params["lm_head"] = {"kernel": jnp.asarray(t("lm_head.weight").T, dt)}
     return params
+
+
+def params_to_hf_dbrx(params: Params, config: DbrxConfig) -> Dict[str, Any]:
+    """Inverse of :func:`params_from_hf_dbrx`: stacked pytree → HF DBRX
+    state dict, re-fusing Wqkv rows [q; k; v] and re-flattening the
+    ``DbrxExpertGLU`` (E·I, H) w1/v1/w2 stacks."""
+    c = config
+    L, E = c.num_layers, c.num_experts
+
+    def np32(x):
+        return np.asarray(x, dtype=np.float32)
+
+    lyr = params["layers"]
+    q_k = np32(lyr["attn"]["qkv"]["q_kernel"])
+    k_k = np32(lyr["attn"]["qkv"]["k_kernel"])
+    v_k = np32(lyr["attn"]["qkv"]["v_kernel"])
+    o_k = np32(lyr["attn"]["o"]["kernel"])
+    n1 = np32(lyr["attn_norm"]["scale"])
+    n2 = np32(lyr["mlp_norm"]["scale"])
+    router = np32(lyr["moe"]["router"]["kernel"])     # (L, H, E)
+    gate_up = np32(lyr["moe"]["experts"]["gate_up"])  # (L, E, H, 2, I)
+    down = np32(lyr["moe"]["experts"]["down"])        # (L, E, I, H)
+
+    sd: Dict[str, Any] = {
+        "transformer.wte.weight": np32(params["embed"]["embedding"]),
+        "transformer.norm_f.weight": np32(params["final_norm"]["scale"]),
+    }
+    for i in range(L):
+        blk = f"transformer.blocks.{i}."
+        sd[blk + "norm_attn_norm.attn.Wqkv.weight"] = np.concatenate(
+            [q_k[i].T, k_k[i].T, v_k[i].T], axis=0
+        )
+        sd[blk + "norm_attn_norm.attn.out_proj.weight"] = o_k[i].T
+        sd[blk + "norm_attn_norm.norm_1.weight"] = n1[i]
+        sd[blk + "norm_attn_norm.norm_2.weight"] = n2[i]
+        sd[blk + "ffn.router.layer.weight"] = router[i].T
+        # gate_up[:, :, 0] = w1ᵀ, [:, :, 1] = v1ᵀ; w2 is (E, I, H) verbatim
+        sd[blk + "ffn.experts.mlp.w1"] = gate_up[i, :, :, 0, :].transpose(
+            0, 2, 1
+        ).reshape(E * c.intermediate_size, c.hidden_size)
+        sd[blk + "ffn.experts.mlp.v1"] = gate_up[i, :, :, 1, :].transpose(
+            0, 2, 1
+        ).reshape(E * c.intermediate_size, c.hidden_size)
+        sd[blk + "ffn.experts.mlp.w2"] = down[i].reshape(
+            E * c.intermediate_size, c.hidden_size
+        )
+    if not c.tie_word_embeddings:
+        sd["lm_head.weight"] = np32(params["lm_head"]["kernel"]).T
+    return sd

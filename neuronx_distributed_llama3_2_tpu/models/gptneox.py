@@ -419,3 +419,108 @@ def params_from_hf_codegen(
             "bias": jnp.asarray(_np(state_dict["lm_head.bias"]), dt),
         },
     }
+
+
+def params_to_hf_neox(params: Params, config: GPTNeoXConfig) -> Dict[str, Any]:
+    """Inverse of :func:`params_from_hf_neox`: stacked pytree → HF GPT-NeoX
+    ``state_dict``, re-fusing q/k/v into HF's per-head-interleaved
+    ``query_key_value`` rows (head n holds rows [q; k; v] of its head_dim).
+    Native→HF direction of the reference's family-generic converter
+    (scripts/checkpoint_converter.py:685)."""
+    c = config
+    L, n, hd = c.num_layers, c.num_heads, c.head_dim
+
+    def np32(x):
+        return np.asarray(x, dtype=np.float32)
+
+    lyr = params["layers"]
+    q_k = np32(lyr["attn"]["qkv"]["q_kernel"])  # (L, H, n·hd)
+    k_k = np32(lyr["attn"]["qkv"]["k_kernel"])
+    v_k = np32(lyr["attn"]["qkv"]["v_kernel"])
+    q_b = np32(lyr["attn"]["qkv"]["q_bias"])
+    k_b = np32(lyr["attn"]["qkv"]["k_bias"])
+    v_b = np32(lyr["attn"]["qkv"]["v_bias"])
+    o_k = np32(lyr["attn"]["o"]["kernel"])
+    o_b = np32(lyr["attn"]["o"]["bias"])
+    n1w, n1b = np32(lyr["attn_norm"]["scale"]), np32(lyr["attn_norm"]["bias"])
+    n2w, n2b = np32(lyr["mlp_norm"]["scale"]), np32(lyr["mlp_norm"]["bias"])
+    upw, upb = np32(lyr["mlp"]["up"]["kernel"]), np32(lyr["mlp"]["up"]["bias"])
+    dnw, dnb = np32(lyr["mlp"]["down"]["kernel"]), np32(lyr["mlp"]["down"]["bias"])
+
+    sd: Dict[str, Any] = {
+        "gpt_neox.embed_in.weight": np32(params["embed"]["embedding"]),
+        "gpt_neox.final_layer_norm.weight": np32(params["final_norm"]["scale"]),
+        "gpt_neox.final_layer_norm.bias": np32(params["final_norm"]["bias"]),
+        "embed_out.weight": np32(params["lm_head"]["kernel"]).T,
+    }
+    for i in range(L):
+        pre = f"gpt_neox.layers.{i}."
+        # head-major (n, hd, H) per component → interleave to (n, 3, hd, H)
+        q = q_k[i].T.reshape(n, hd, -1)
+        k = k_k[i].T.reshape(n, hd, -1)
+        v = v_k[i].T.reshape(n, hd, -1)
+        w = np.stack([q, k, v], axis=1).reshape(3 * n * hd, -1)
+        b = np.stack(
+            [q_b[i].reshape(n, hd), k_b[i].reshape(n, hd), v_b[i].reshape(n, hd)],
+            axis=1,
+        ).reshape(-1)
+        sd[pre + "attention.query_key_value.weight"] = w
+        sd[pre + "attention.query_key_value.bias"] = b
+        sd[pre + "attention.dense.weight"] = o_k[i].T
+        sd[pre + "attention.dense.bias"] = o_b[i]
+        sd[pre + "input_layernorm.weight"] = n1w[i]
+        sd[pre + "input_layernorm.bias"] = n1b[i]
+        sd[pre + "post_attention_layernorm.weight"] = n2w[i]
+        sd[pre + "post_attention_layernorm.bias"] = n2b[i]
+        sd[pre + "mlp.dense_h_to_4h.weight"] = upw[i].T
+        sd[pre + "mlp.dense_h_to_4h.bias"] = upb[i]
+        sd[pre + "mlp.dense_4h_to_h.weight"] = dnw[i].T
+        sd[pre + "mlp.dense_4h_to_h.bias"] = dnb[i]
+    return sd
+
+
+def params_to_hf_codegen(
+    params: Params, config: GPTNeoXConfig, mp_num: int = 4
+) -> Dict[str, Any]:
+    """Inverse of :func:`params_from_hf_codegen`: re-fuses q/k/v into
+    CodeGen's mp_num-blocked [query; value; key] ``qkv_proj`` layout."""
+    c = config
+    L, n, hd = c.num_layers, c.num_heads, c.head_dim
+
+    def np32(x):
+        return np.asarray(x, dtype=np.float32)
+
+    lyr = params["layers"]
+    q_k = np32(lyr["attn"]["qkv"]["q_kernel"])
+    k_k = np32(lyr["attn"]["qkv"]["k_kernel"])
+    v_k = np32(lyr["attn"]["qkv"]["v_kernel"])
+    o_k = np32(lyr["attn"]["o"]["kernel"])
+    n1w, n1b = np32(lyr["attn_norm"]["scale"]), np32(lyr["attn_norm"]["bias"])
+    upw, upb = np32(lyr["mlp"]["up"]["kernel"]), np32(lyr["mlp"]["up"]["bias"])
+    dnw, dnb = np32(lyr["mlp"]["down"]["kernel"]), np32(lyr["mlp"]["down"]["bias"])
+
+    sd: Dict[str, Any] = {
+        "transformer.wte.weight": np32(params["embed"]["embedding"]),
+        "transformer.ln_f.weight": np32(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": np32(params["final_norm"]["bias"]),
+        "lm_head.weight": np32(params["lm_head"]["kernel"]).T,
+    }
+    if "bias" in params["lm_head"]:
+        sd["lm_head.bias"] = np32(params["lm_head"]["bias"])
+    n_loc = n // mp_num
+    for i in range(L):
+        pre = f"transformer.h.{i}."
+        # head-major (mp, n/mp·hd, H) blocks, fused per block as [q; v; k]
+        q = q_k[i].T.reshape(mp_num, n_loc * hd, -1)
+        k = k_k[i].T.reshape(mp_num, n_loc * hd, -1)
+        v = v_k[i].T.reshape(mp_num, n_loc * hd, -1)
+        w = np.concatenate([q, v, k], axis=1).reshape(3 * n * hd, -1)
+        sd[pre + "attn.qkv_proj.weight"] = w
+        sd[pre + "attn.out_proj.weight"] = o_k[i].T
+        sd[pre + "ln_1.weight"] = n1w[i]
+        sd[pre + "ln_1.bias"] = n1b[i]
+        sd[pre + "mlp.fc_in.weight"] = upw[i].T
+        sd[pre + "mlp.fc_in.bias"] = upb[i]
+        sd[pre + "mlp.fc_out.weight"] = dnw[i].T
+        sd[pre + "mlp.fc_out.bias"] = dnb[i]
+    return sd

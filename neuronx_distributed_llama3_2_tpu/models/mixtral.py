@@ -313,3 +313,51 @@ def params_from_hf_mixtral(
             "kernel": jnp.asarray(t("lm_head.weight").T, c.dtype)
         }
     return params
+
+
+def params_to_hf_mixtral(
+    params: Params, config: MixtralConfig
+) -> Dict[str, Any]:
+    """Inverse of :func:`params_from_hf_mixtral`: stacked pytree → HF Mixtral
+    ``state_dict`` (numpy fp32, torch (out, in) Linear layout). The
+    native→HF direction of the reference's family-generic converter
+    (scripts/checkpoint_converter.py:685)."""
+    import numpy as np
+
+    c = config
+    L, E = c.num_layers, c.num_experts
+
+    def np32(x):
+        return np.asarray(x, dtype=np.float32)
+
+    lyr = params["layers"]
+    sd: Dict[str, Any] = {
+        "model.embed_tokens.weight": np32(params["embed"]["embedding"]),
+        "model.norm.weight": np32(params["final_norm"]["scale"]),
+    }
+    attn_norm = np32(lyr["attn_norm"]["scale"])
+    mlp_norm = np32(lyr["mlp_norm"]["scale"])
+    q_k = np32(lyr["attn"]["qkv"]["q_kernel"])
+    k_k = np32(lyr["attn"]["qkv"]["k_kernel"])
+    v_k = np32(lyr["attn"]["qkv"]["v_kernel"])
+    o_k = np32(lyr["attn"]["o"]["kernel"])
+    router = np32(lyr["moe"]["router"]["kernel"])      # (L, H, E)
+    gate_up = np32(lyr["moe"]["experts"]["gate_up"])   # (L, E, H, 2, I)
+    down = np32(lyr["moe"]["experts"]["down"])         # (L, E, I, H)
+    for i in range(L):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = attn_norm[i]
+        sd[p + "post_attention_layernorm.weight"] = mlp_norm[i]
+        sd[p + "self_attn.q_proj.weight"] = q_k[i].T
+        sd[p + "self_attn.k_proj.weight"] = k_k[i].T
+        sd[p + "self_attn.v_proj.weight"] = v_k[i].T
+        sd[p + "self_attn.o_proj.weight"] = o_k[i].T
+        moe = p + "block_sparse_moe."
+        sd[moe + "gate.weight"] = router[i].T
+        for e in range(E):
+            sd[moe + f"experts.{e}.w1.weight"] = gate_up[i, e, :, 0, :].T
+            sd[moe + f"experts.{e}.w3.weight"] = gate_up[i, e, :, 1, :].T
+            sd[moe + f"experts.{e}.w2.weight"] = down[i, e].T
+    if not c.tie_word_embeddings:
+        sd["lm_head.weight"] = np32(params["lm_head"]["kernel"]).T
+    return sd

@@ -86,15 +86,30 @@ def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
     produces a single 140GB file."""
     import jax.numpy as jnp
     import numpy as np
+
+    dtype = np.dtype(config.dtype) if config.dtype != jnp.bfloat16 else jnp.bfloat16
+    itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
+    _write_sharded_safetensors(
+        sd,
+        path,
+        base="model",
+        itemsize=itemsize,
+        cast=lambda v: np.ascontiguousarray(np.asarray(v).astype(dtype)),
+    )
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(_hf_config_dict(config), f, indent=2)
+
+
+def _write_sharded_safetensors(
+    sd: Dict[str, Any], path: str, base: str, itemsize: int, cast
+) -> None:
+    """Greedy ~5GB shard split + ``{base}.safetensors[.index.json]`` naming
+    (HF convention). Tensors are cast per shard at write time so the extra
+    host footprint is one shard, not a full second copy of the model. Shared
+    by the weight export (dtype-cast) and the optimizer export (raw fp32)."""
     from safetensors.numpy import save_file
 
     os.makedirs(path, exist_ok=True)
-    dtype = np.dtype(config.dtype) if config.dtype != jnp.bfloat16 else jnp.bfloat16
-    itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
-
-    # greedy shard split by post-cast size (HF convention: index.json maps
-    # tensor -> file); tensors are cast per shard at write time so the extra
-    # host footprint is one shard, not a full second copy of the model
     shards, cur, cur_bytes = [], [], 0
     for k, v in sd.items():
         nbytes = v.size * itemsize
@@ -106,37 +121,118 @@ def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
     shards.append(cur)
 
     def cast_shard(keys):
-        return {
-            k: np.ascontiguousarray(np.asarray(sd[k]).astype(dtype)) for k in keys
-        }
+        return {k: cast(sd[k]) for k in keys}
 
     if len(shards) == 1:
-        save_file(cast_shard(shards[0]), os.path.join(path, "model.safetensors"))
-    else:
-        total = sum(v.size * itemsize for v in sd.values())
-        index = {"metadata": {"total_size": total}, "weight_map": {}}
-        for i, keys in enumerate(shards):
-            name = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
-            save_file(cast_shard(keys), os.path.join(path, name))
-            for k in keys:
-                index["weight_map"][k] = name
-        with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
-            json.dump(index, f, indent=2)
+        save_file(
+            cast_shard(shards[0]), os.path.join(path, f"{base}.safetensors")
+        )
+        return
+    total = sum(v.size * itemsize for v in sd.values())
+    index = {"metadata": {"total_size": total}, "weight_map": {}}
+    for i, keys in enumerate(shards):
+        name = f"{base}-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+        save_file(cast_shard(keys), os.path.join(path, name))
+        for k in keys:
+            index["weight_map"][k] = name
+    with open(os.path.join(path, f"{base}.safetensors.index.json"), "w") as f:
+        json.dump(index, f, indent=2)
+
+
+def _hf_config_dict(config) -> Dict[str, Any]:
+    """Family-aware HF ``config.json`` contents, keyed off the config class
+    (the converter serves every registry family, not just Llama)."""
+    import jax.numpy as jnp
+
+    name = type(config).__name__
+    if name == "BertConfig":
+        return {
+            "architectures": ["BertForPreTraining"],
+            "model_type": "bert",
+            "hidden_size": config.hidden_size,
+            "intermediate_size": config.intermediate_size,
+            "num_hidden_layers": config.num_layers,
+            "num_attention_heads": config.num_heads,
+            "vocab_size": config.vocab_size,
+            "max_position_embeddings": config.max_position_embeddings,
+            "type_vocab_size": config.type_vocab_size,
+            "layer_norm_eps": config.layer_norm_eps,
+            "torch_dtype": str(jnp.dtype(config.dtype)),
+        }
+    if name == "GPTNeoXConfig" and config.rotary_interleaved:
+        # transformers CodeGenConfig attribute names (n_embd/n_layer/...)
+        return {
+            "architectures": ["CodeGenForCausalLM"],
+            "model_type": "codegen",
+            "n_embd": config.hidden_size,
+            "n_inner": config.intermediate_size,
+            "n_layer": config.num_layers,
+            "n_head": config.num_heads,
+            "n_positions": config.max_seq_len,
+            "n_ctx": config.max_seq_len,
+            "rotary_dim": int(config.head_dim * config.rotary_pct),
+            "vocab_size": config.vocab_size,
+            "tie_word_embeddings": config.tie_word_embeddings,
+            "torch_dtype": str(jnp.dtype(config.dtype)),
+        }
+    if name == "DbrxConfig":
+        # transformers DbrxConfig attribute names (d_model/n_heads/...)
+        return {
+            "architectures": ["DbrxForCausalLM"],
+            "model_type": "dbrx",
+            "d_model": config.hidden_size,
+            "n_heads": config.num_heads,
+            "n_layers": config.num_layers,
+            "max_seq_len": config.max_seq_len,
+            "vocab_size": config.vocab_size,
+            "tie_word_embeddings": config.tie_word_embeddings,
+            "attn_config": {
+                "clip_qkv": config.clip_qkv,
+                "kv_n_heads": config.num_kv_heads,
+                "rope_theta": config.rope_theta,
+            },
+            "ffn_config": {
+                "ffn_hidden_size": config.intermediate_size,
+                "moe_num_experts": config.num_experts,
+                "moe_top_k": config.top_k,
+            },
+            "torch_dtype": str(jnp.dtype(config.dtype)),
+        }
     cfg = {
-        "architectures": ["LlamaForCausalLM"],
-        "model_type": "llama",
         "hidden_size": config.hidden_size,
         "intermediate_size": config.intermediate_size,
         "num_hidden_layers": config.num_layers,
         "num_attention_heads": config.num_heads,
-        "num_key_value_heads": config.num_kv_heads,
         "vocab_size": config.vocab_size,
-        "rms_norm_eps": config.rms_norm_eps,
-        "rope_theta": config.rope_theta,
         "tie_word_embeddings": config.tie_word_embeddings,
         "max_position_embeddings": config.max_seq_len,
         "torch_dtype": str(jnp.dtype(config.dtype)),
     }
+    if name == "GPTNeoXConfig":
+        cfg.update(
+            architectures=["GPTNeoXForCausalLM"],
+            model_type="gpt_neox",
+            rotary_pct=config.rotary_pct,
+            rotary_emb_base=config.rope_theta,
+            use_parallel_residual=config.parallel_residual,
+            layer_norm_eps=config.rms_norm_eps,
+        )
+        return cfg
+    cfg.update(
+        num_key_value_heads=config.num_kv_heads,
+        rms_norm_eps=config.rms_norm_eps,
+        rope_theta=config.rope_theta,
+    )
+    if name == "MixtralConfig":
+        cfg.update(
+            architectures=["MixtralForCausalLM"],
+            model_type="mixtral",
+            num_local_experts=config.num_experts,
+            num_experts_per_tok=config.top_k,
+            router_aux_loss_coef=config.router_aux_loss_coef,
+        )
+        return cfg
+    cfg.update(architectures=["LlamaForCausalLM"], model_type="llama")
     if config.rope_scaling is not None:
         # HF "llama3" rope scaling dict — omitting it would silently load
         # published Llama-3.2 weights with unscaled RoPE (review finding)
@@ -148,8 +244,7 @@ def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
             "high_freq_factor": high,
             "original_max_position_embeddings": orig,
         }
-    with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump(cfg, f, indent=2)
+    return cfg
 
 
 def _resolve_model(name: str) -> Dict[str, Any]:
@@ -178,8 +273,7 @@ def native_to_hf(args) -> None:
     entry = _resolve_model(args.model)
     if entry["to_hf"] is None:
         raise NotImplementedError(
-            f"native→HF export is implemented for the Llama family only; "
-            f"{args.model!r} has no to_hf converter yet"
+            f"{args.model!r} has no to_hf converter in the model registry"
         )
     config = entry["config"]
     template = jax.eval_shape(
@@ -190,7 +284,96 @@ def native_to_hf(args) -> None:
         raise FileNotFoundError(f"no checkpoint tag {args.tag} under {args.input}")
     sd = entry["to_hf"](loaded["model"], config)
     save_hf_state_dict(sd, args.output, config)
+    if getattr(args, "include_optimizer", False):
+        export_optimizer_state(args, entry, template)
     logger.info("wrote HF checkpoint to %s", args.output)
+
+
+def export_optimizer_state(args, entry, param_template) -> None:
+    """Export AdamW state alongside the HF weights (the role of the
+    reference's ZeRO-state conversion CLI,
+    ``optimizer/convert_zero_checkpoints.py:176`` — which must merge per-dp
+    shards; global arrays dissolve that, leaving the HF-naming translation).
+
+    Documented layout, under ``<output>/optimizer/``:
+
+    - ``optimizer-*.safetensors`` (~5GB shards + index.json when split):
+      fp32 tensors keyed ``<kind>::<hf_param_name>`` where kind ∈
+      {``master``, ``mu``, ``nu``} — fp32 master weights (absent when the
+      run used pure-bf16 state), Adam first and second moments. Each tensor
+      is laid out exactly like its weight in the HF export (same
+      transposes/fusions applied, elementwise correspondence preserved).
+    - ``optimizer.json``: {"kinds": [...], "model": ..., "format": 1}.
+    """
+    from neuronx_distributed_llama3_2_tpu.checkpoint import load_checkpoint
+    from neuronx_distributed_llama3_2_tpu.trainer.optimizer import (
+        OptimizerState,
+    )
+
+    config = entry["config"]
+    import jax
+
+    step_t = jax.ShapeDtypeStruct((), "int32")
+    with_master = OptimizerState(
+        step=step_t, master=param_template, mu=param_template,
+        nu=param_template,
+    )
+    without_master = OptimizerState(
+        step=step_t, master=None, mu=param_template, nu=param_template
+    )
+    loaded = None
+    for template in (with_master, without_master):
+        try:
+            loaded = load_checkpoint(
+                args.input, tag=args.tag, optimizer=template
+            )
+            break
+        except (KeyError, FileNotFoundError, ValueError):
+            continue
+    if loaded is None or loaded.get("optimizer") is None:
+        raise FileNotFoundError(
+            f"checkpoint tag {args.tag} under {args.input} has no optimizer "
+            f"state (was it written with save_checkpoint(optimizer=...)?)"
+        )
+    opt = loaded["optimizer"]
+    kinds = {"mu": opt.mu, "nu": opt.nu}
+    if opt.master is not None:
+        kinds["master"] = opt.master
+    sd: Dict[str, Any] = {}
+    for kind, tree in kinds.items():
+        # moments/master share the params' tree structure, so the family's
+        # to_hf applies the identical layout transforms — elementwise
+        # correspondence with the exported weights is preserved
+        for name, value in entry["to_hf"](tree, config).items():
+            sd[f"{kind}::{name}"] = value
+    out = os.path.join(args.output, "optimizer")
+    _write_sharded_fp32(sd, out, base="optimizer")
+    with open(os.path.join(out, "optimizer.json"), "w") as f:
+        json.dump(
+            {
+                "format": 1,
+                "model": args.model,
+                "kinds": sorted(kinds),
+                "step": int(opt.step),
+            },
+            f,
+            indent=2,
+        )
+    logger.info("wrote optimizer export (%s) to %s", ", ".join(sorted(kinds)), out)
+
+
+def _write_sharded_fp32(sd: Dict[str, Any], path: str, base: str) -> None:
+    """fp32 safetensors with the same ~5GB shard convention as the weight
+    export (no dtype cast — optimizer state is meaningful only in fp32)."""
+    import numpy as np
+
+    _write_sharded_safetensors(
+        sd,
+        path,
+        base=base,
+        itemsize=4,
+        cast=lambda v: np.ascontiguousarray(np.asarray(v, np.float32)),
+    )
 
 
 def strip_optimizer(args) -> None:
@@ -245,6 +428,12 @@ def main(argv=None) -> None:
     p.add_argument("--output", required=True)
     p.add_argument("--tag", default="latest", help="native checkpoint tag")
     p.add_argument("--out-tag", default=None)
+    p.add_argument(
+        "--include-optimizer",
+        action="store_true",
+        help="native-to-hf only: also export AdamW state (fp32 master + "
+        "moments) to <output>/optimizer/ — see export_optimizer_state",
+    )
     args = p.parse_args(argv)
     if args.direction != "copy-tag" and args.model is None:
         p.error(f"--model is required for --direction {args.direction}")

@@ -1,6 +1,8 @@
 """Checkpoint converter tests (VERDICT missing #7): HF↔native roundtrips and
 the CLI entry points (reference scripts/checkpoint_converter.py:238,393)."""
 
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -238,3 +240,170 @@ def test_generate_cli_arg_validation():
     )
     assert r.returncode != 0  # malformed ids must not silently generate
 
+
+
+def test_to_hf_roundtrip_all_families():
+    """Native→HF for every family (VERDICT r2 missing #3): (a) to_hf values
+    bit-match the original HF state dict on every exported key; (b)
+    from_hf(to_hf(params)) is the identity — no information loss."""
+    from neuronx_distributed_llama3_2_tpu.scripts.checkpoint_converter import (
+        _resolve_model,
+    )
+    from tests.test_dbrx import _hf_tiny_dbrx, _hf_tiny_mixtral
+    from tests.test_gptneox import _hf_codegen, _hf_neox
+    from tests.test_bert import _hf_bert
+
+    cases = {
+        "tiny-moe": _hf_tiny_mixtral(),
+        "tiny-dbrx": _hf_tiny_dbrx(),
+        "tiny-neox": _hf_neox(),
+        "tiny-codegen": _hf_codegen(),
+        "tiny-bert": _hf_bert(),
+    }
+    for name, hf in cases.items():
+        entry = _resolve_model(name)
+        sd = {
+            k: v.detach().numpy().astype(np.float32)
+            for k, v in hf.state_dict().items()
+        }
+        params = entry["from_hf"](sd, entry["config"])
+        back = entry["to_hf"](params, entry["config"])
+        for k, v in back.items():
+            assert k in sd, (name, k)
+            np.testing.assert_allclose(
+                v, sd[k], atol=1e-6, err_msg=f"{name}:{k}"
+            )
+        again = entry["from_hf"](back, entry["config"])
+        for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(again)[0],
+        ):
+            assert pa == pb
+            np.testing.assert_array_equal(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                err_msg=f"{name}:{pa}",
+            )
+
+
+def test_cli_include_optimizer_export(tmp_path):
+    """--include-optimizer: fp32 master + moments exported to
+    optimizer/*.safetensors with HF names, elementwise-aligned with the
+    weight export (reference optimizer/convert_zero_checkpoints.py:176)."""
+    from safetensors.numpy import load_file
+
+    from neuronx_distributed_llama3_2_tpu.checkpoint import save_checkpoint
+    from neuronx_distributed_llama3_2_tpu.trainer.optimizer import (
+        OptimizerState,
+    )
+
+    params = _tiny_params()
+    opt = OptimizerState(
+        step=jnp.asarray(7, jnp.int32),
+        master=jax.tree.map(lambda p: p.astype(jnp.float32), params),
+        mu=jax.tree.map(lambda p: jnp.full(p.shape, 0.25, jnp.float32), params),
+        nu=jax.tree.map(lambda p: jnp.full(p.shape, 0.5, jnp.float32), params),
+    )
+    ckpt = tmp_path / "native"
+    save_checkpoint(str(ckpt), tag="trained", model=params, optimizer=opt)
+
+    out = tmp_path / "hf"
+    cli([
+        "--direction", "native-to-hf", "--model", "tiny",
+        "--input", str(ckpt), "--output", str(out), "--tag", "trained",
+        "--include-optimizer",
+    ])
+    exported = load_file(str(out / "optimizer" / "optimizer.safetensors"))
+    meta = json.loads((out / "optimizer" / "optimizer.json").read_text())
+    assert meta["kinds"] == ["master", "mu", "nu"]
+    assert meta["step"] == 7
+    # moments carry the HF layout transforms; constant trees stay constant
+    key = "mu::model.layers.0.self_attn.q_proj.weight"
+    assert exported[key].dtype == np.float32
+    np.testing.assert_array_equal(exported[key], 0.25)
+    np.testing.assert_array_equal(
+        exported["nu::model.norm.weight"], 0.5
+    )
+    # master round-trips the weights bit-exactly (fp32)
+    from neuronx_distributed_llama3_2_tpu.models.llama import params_to_hf
+
+    want = params_to_hf(params, TINY)
+    for k, v in want.items():
+        np.testing.assert_array_equal(exported[f"master::{k}"], v)
+
+
+def test_cli_include_optimizer_without_master(tmp_path):
+    """Pure-bf16 runs (use_master_weights=False) export mu/nu only."""
+    from safetensors.numpy import load_file
+
+    from neuronx_distributed_llama3_2_tpu.checkpoint import save_checkpoint
+    from neuronx_distributed_llama3_2_tpu.trainer.optimizer import (
+        OptimizerState,
+    )
+
+    params = _tiny_params()
+    opt = OptimizerState(
+        step=jnp.asarray(3, jnp.int32),
+        master=None,
+        mu=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+        nu=jax.tree.map(lambda p: jnp.ones(p.shape, jnp.float32), params),
+    )
+    ckpt = tmp_path / "native"
+    save_checkpoint(str(ckpt), tag="trained", model=params, optimizer=opt)
+    out = tmp_path / "hf"
+    cli([
+        "--direction", "native-to-hf", "--model", "tiny",
+        "--input", str(ckpt), "--output", str(out), "--tag", "trained",
+        "--include-optimizer",
+    ])
+    meta = json.loads((out / "optimizer" / "optimizer.json").read_text())
+    assert meta["kinds"] == ["mu", "nu"]
+    exported = load_file(str(out / "optimizer" / "optimizer.safetensors"))
+    assert not any(k.startswith("master::") for k in exported)
+
+
+def test_exported_config_json_loads_in_transformers():
+    """config.json uses each family's real HF attribute names (review
+    finding: Llama-style keys would make transformers build default-sized
+    models and fail on shape mismatch)."""
+    from transformers import (
+        CodeGenConfig,
+        DbrxConfig,
+        GPTNeoXConfig,
+        MixtralConfig,
+    )
+
+    from neuronx_distributed_llama3_2_tpu.models import (
+        DBRX_CONFIGS,
+        GPTNEOX_CONFIGS,
+        MIXTRAL_CONFIGS,
+    )
+    from neuronx_distributed_llama3_2_tpu.scripts.checkpoint_converter import (
+        _hf_config_dict,
+    )
+
+    d = _hf_config_dict(DBRX_CONFIGS["tiny-dbrx"])
+    hc = DbrxConfig(**{k: v for k, v in d.items() if k != "architectures"})
+    assert hc.d_model == 64 and hc.n_layers == 2 and hc.n_heads == 8
+    assert hc.attn_config.kv_n_heads == 4 and hc.attn_config.clip_qkv == 8.0
+    assert hc.ffn_config.moe_num_experts == 4 and hc.ffn_config.moe_top_k == 2
+
+    d = _hf_config_dict(GPTNEOX_CONFIGS["tiny-codegen"])
+    hc = CodeGenConfig(**{k: v for k, v in d.items() if k != "architectures"})
+    cfg = GPTNEOX_CONFIGS["tiny-codegen"]
+    assert hc.n_embd == cfg.hidden_size and hc.n_layer == cfg.num_layers
+    assert hc.n_head == cfg.num_heads
+    assert hc.rotary_dim == int(cfg.head_dim * cfg.rotary_pct)
+
+    d = _hf_config_dict(GPTNEOX_CONFIGS["tiny-neox"])
+    hc = GPTNeoXConfig(**{k: v for k, v in d.items() if k != "architectures"})
+    cfg = GPTNEOX_CONFIGS["tiny-neox"]
+    assert hc.hidden_size == cfg.hidden_size
+    assert hc.rotary_pct == cfg.rotary_pct
+    assert hc.use_parallel_residual == cfg.parallel_residual
+
+    d = _hf_config_dict(MIXTRAL_CONFIGS["tiny-moe"])
+    hc = MixtralConfig(**{k: v for k, v in d.items() if k != "architectures"})
+    cfg = MIXTRAL_CONFIGS["tiny-moe"]
+    assert hc.num_local_experts == cfg.num_experts
+    assert hc.num_experts_per_tok == cfg.top_k
+    assert hc.num_key_value_heads == cfg.num_kv_heads
