@@ -1,0 +1,135 @@
+"""Two-process jax.distributed worker (driven by test_multihost_mp.py).
+
+Each process owns 4 virtual CPU devices; together they form the 8-device
+"2-host pod" on which the DCN-aware mesh build, host-0 broadcast, a real
+train step, and the single-writer checkpoint protocol are exercised —
+SURVEY §4's "multi-node without cluster" tier (a), upgraded from mocks to
+real multi-process jax (VERDICT r2 weak #4).
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port> <tmpdir>
+"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def main() -> None:
+    pid, nproc, port, tmpdir = (
+        int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+
+    from neuronx_distributed_llama3_2_tpu.parallel.multihost import (
+        broadcast_from_host0,
+        initialize_distributed,
+        is_coordinator,
+        sync_global_devices,
+    )
+
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 4 * nproc
+    assert is_coordinator() == (pid == 0)
+
+    # -- host-0 broadcast (reference gloo side-channel role) --------------
+    local = {"lr": 0.1, "step": 5} if pid == 0 else {"lr": -1.0, "step": -5}
+    agreed = broadcast_from_host0(local)
+    assert abs(float(agreed["lr"]) - 0.1) < 1e-6, agreed
+    assert int(agreed["step"]) == 5, agreed
+
+    # -- DCN-aware mesh: dp spans the two hosts, tp stays host-local ------
+    from neuronx_distributed_llama3_2_tpu.trainer import (
+        OptimizerConfig,
+        TrainingConfig,
+        initialize_parallel_model,
+        make_train_step,
+    )
+    from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+
+    cfg = TrainingConfig(
+        tensor_parallel_size=4,  # dp = 8/4 = 2 == host count
+        optimizer=OptimizerConfig(
+            learning_rate=1e-3, warmup_steps=0, schedule="constant"
+        ),
+    )
+    cfg.initialize()
+    mesh = parallel_state.get_parallel_state().mesh
+    devs = mesh.devices  # (pp, dp, cp, ep, tp)
+    assert devs.shape == (1, 2, 1, 1, 4), devs.shape
+    for dp_row in range(2):
+        procs = {d.process_index for d in devs[0, dp_row, 0, 0]}
+        assert procs == {dp_row}, (
+            f"dp row {dp_row} spans processes {procs}; tp must stay "
+            f"host-local (DCN-aware build)"
+        )
+
+    # -- one real train step on the 2-host mesh ---------------------------
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+    )
+
+    model = LlamaForCausalLM(LLAMA_CONFIGS["tiny"])
+    state, _ = initialize_parallel_model(model, cfg)
+    step = make_train_step(model, cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, LLAMA_CONFIGS["tiny"].vocab_size, (8, 16)
+        ),
+        jnp.int32,
+    )
+    state, metrics = step(state, {"input_ids": ids, "labels": ids})
+    loss = float(metrics["loss"])  # replicated scalar: addressable everywhere
+    assert np.isfinite(loss), loss
+
+    # -- checkpoint: every process participates, exactly one writes -------
+    from neuronx_distributed_llama3_2_tpu.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from neuronx_distributed_llama3_2_tpu.checkpoint import storage as storage_mod
+
+    writes = {"n": 0}
+    orig = storage_mod.FilesysCheckpointStorage.save_bytes
+
+    def counting_save_bytes(self, data, path):
+        writes["n"] += 1
+        return orig(self, data, path)
+
+    storage_mod.FilesysCheckpointStorage.save_bytes = counting_save_bytes
+    save_checkpoint(tmpdir, tag="mh", model=state.params)
+    sync_global_devices("after-save")
+    if pid == 0:
+        assert writes["n"] > 0, "coordinator wrote nothing"
+    else:
+        assert writes["n"] == 0, (
+            f"non-coordinator performed {writes['n']} writes — the "
+            f"single-writer gating (_is_writer) is broken"
+        )
+
+    # both processes can load it back and see identical values
+    template = jax.eval_shape(model.init, jax.random.key(0))
+    loaded = load_checkpoint(tmpdir, tag="mh", model=template)
+    want = np.asarray(
+        jax.experimental.multihost_utils.process_allgather(
+            state.params["final_norm"]["scale"], tiled=True
+        )
+    )
+    got = np.asarray(loaded["model"]["final_norm"]["scale"])
+    np.testing.assert_array_equal(got, want)
+
+    sync_global_devices("done")
+    print(f"WORKER_OK {pid} loss={loss:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
