@@ -470,3 +470,93 @@ def test_bert_decode_refused():
 
     with pytest.raises(NotImplementedError, match="bidirectional"):
         decode_model_for(BERT_CONFIGS["tiny-bert"])
+
+
+def test_no_compile_under_churn(params):
+    """Serving never compiles mid-traffic (VERDICT r2 weak #5): after
+    ContinuousBatchingEngine construction (precompile on), a churn run with
+    staggered admissions crossing kv-bucket boundaries adds NO new program
+    keys, and every program in the table is an AOT executable, not a lazy
+    jit wrapper."""
+    engine = InferenceEngine(
+        TINY, params, max_batch=2, max_seq_len=64, buckets=[16, 32, 64]
+    )
+    gen = GenerationConfig(max_new_tokens=24, sampling=SamplingConfig(greedy=True))
+    cb = ContinuousBatchingEngine(engine, gen)
+    keys_after_warmup = set(engine._programs)
+    assert keys_after_warmup, "precompile produced no programs"
+    # every warmed program is compiled (AOT), not a lazy jit wrapper
+    lazy = [k for k, fn in engine._programs.items() if hasattr(fn, "lower")]
+    assert not lazy, lazy
+
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(0, TINY.vocab_size, size=(n,)).tolist()
+        for n in (5, 9, 13, 7, 11)
+    ]
+    # staggered submissions: request stream longer than slots, positions
+    # cross the 16 and 32 kv-bucket boundaries mid-run
+    cb.submit(prompts[0])
+    cb.submit(prompts[1])
+    steps = 0
+    alive = True
+    next_req = 2
+    while alive or next_req < len(prompts):
+        if steps % 3 == 0 and next_req < len(prompts):
+            cb.submit(prompts[next_req])
+            next_req += 1
+        alive = cb.step()
+        steps += 1
+    assert len(cb._finished) == len(prompts)
+    assert set(engine._programs) == keys_after_warmup, (
+        set(engine._programs) - keys_after_warmup
+    )
+
+
+def test_generate_precompiles_reachable_buckets(params):
+    """generate(precompile=True) compiles its whole reachable set before
+    the first token; the decode loop then finds every program AOT-ready."""
+    engine = InferenceEngine(
+        TINY, params, max_batch=1, max_seq_len=64, buckets=[16, 32, 64]
+    )
+    prompt = list(range(1, 10))
+    gen = GenerationConfig(max_new_tokens=30, sampling=SamplingConfig(greedy=True))
+    res = engine.generate([prompt], gen)
+    assert len(res.sequences[0]) == 30
+    lazy = [k for k, fn in engine._programs.items() if hasattr(fn, "lower")]
+    assert not lazy, f"programs left lazily-compiled: {lazy}"
+
+
+def test_serving_churn_benchmark(params):
+    """The churn benchmark reports throughput and zero compiles under
+    traffic."""
+    from neuronx_distributed_llama3_2_tpu.inference.runner import (
+        benchmark_serving_churn,
+    )
+
+    engine = InferenceEngine(
+        TINY, params, max_batch=2, max_seq_len=64, buckets=[16, 32, 64]
+    )
+    rep = benchmark_serving_churn(
+        engine, n_requests=4, prompt_len=8, max_new_tokens=6, admit_every=2
+    )
+    assert rep["compiled_under_traffic"] == 0, rep
+    assert rep["requests_per_s"] > 0 and rep["tokens_per_s"] > 0
+
+
+def test_no_compile_under_churn_with_bucket_fallback(params):
+    """Review-found regression: when the bucket ladder tops out below
+    max_seq_len, decode falls back to the full-cache kv bucket — the warmup
+    must compile that fallback program too, or the first long request pays
+    a compile mid-traffic."""
+    engine = InferenceEngine(
+        TINY, params, max_batch=1, max_seq_len=64, buckets=[16, 32]
+    )
+    gen = GenerationConfig(max_new_tokens=40, sampling=SamplingConfig(greedy=True))
+    cb = ContinuousBatchingEngine(engine, gen)
+    keys_after_warmup = set(engine._programs)
+    cb.submit(list(range(1, 9)))  # 8-token prompt + 40 new crosses 32
+    cb.run_to_completion()
+    assert set(engine._programs) == keys_after_warmup, (
+        set(engine._programs) - keys_after_warmup
+    )
