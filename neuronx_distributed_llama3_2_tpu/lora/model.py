@@ -48,6 +48,13 @@ class LoraConfig:
     alpha: float = 16.0
     # regexes matched against '/'-joined param paths
     target_modules: Tuple[str, ...] = DEFAULT_TARGETS
+    # regexes naming HWIO conv kernels (reference LoraConv2d layer.py:334 —
+    # A carries the base's spatial kernel, B is the 1x1 mixing conv). Conv
+    # kernels must be listed HERE, not in target_modules: a (kh, kw, I, O)
+    # kernel is shape-indistinguishable from a stacked fused linear, so the
+    # caller names them explicitly (the reference's analogue decision is
+    # dispatch on module class, lora/model.py:317).
+    conv_target_modules: Tuple[str, ...] = ()
     # rsLoRA scaling alpha/sqrt(r) instead of alpha/r (config.py rslora)
     use_rslora: bool = False
     dtype: Any = None  # None = target dtype
@@ -102,9 +109,29 @@ class LoraModel:
         self.base_params = base_params
         self.lora_config = config
         self._targets = _iter_targets(base_params, config.target_modules)
-        if not self._targets:
+        self._conv_targets = (
+            _iter_targets(base_params, config.conv_target_modules)
+            if config.conv_target_modules
+            else {}
+        )
+        overlap = set(self._targets) & set(self._conv_targets)
+        if overlap:
             raise ValueError(
-                f"no parameters match target_modules={config.target_modules}"
+                f"paths matched by both target_modules and "
+                f"conv_target_modules: {sorted(overlap)}"
+            )
+        bad_conv = [
+            p for p, leaf in self._conv_targets.items() if len(leaf.shape) != 4
+        ]
+        if bad_conv:
+            raise ValueError(
+                f"conv_target_modules must name HWIO rank-4 kernels; got "
+                f"{[(p, self._conv_targets[p].shape) for p in bad_conv]}"
+            )
+        if not self._targets and not self._conv_targets:
+            raise ValueError(
+                f"no parameters match target_modules={config.target_modules} "
+                f"or conv_target_modules={config.conv_target_modules}"
             )
         expert_hits = [p for p in self._targets if re.search(r"experts/", p)]
         if expert_hits:
@@ -126,7 +153,8 @@ class LoraModel:
         exactly equal to the base (reference LoraLayer reset, layer.py)."""
         cfg = self.lora_config
         adapters: Params = {}
-        keys = jax.random.split(key, len(self._targets))
+        n = len(self._targets) + len(self._conv_targets)
+        keys = jax.random.split(key, n)
         for k, (path, leaf) in zip(keys, sorted(self._targets.items())):
             stack, fan_in, out_dims = _split_shape(leaf.shape)
             dt = cfg.dtype or leaf.dtype
@@ -135,6 +163,19 @@ class LoraModel:
                 / (fan_in ** 0.5)
             ).astype(dt)
             b = jnp.zeros((*stack, cfg.r, *out_dims), dt)
+            adapters[path] = {"a": a, "b": b}
+        for k, (path, leaf) in zip(
+            keys[len(self._targets):], sorted(self._conv_targets.items())
+        ):
+            # reference LoraConv2d (layer.py:334): A is a conv with the
+            # base's spatial kernel (kh, kw, I, r), B the 1x1 mixing (r, O)
+            kh, kw, cin, cout = leaf.shape
+            dt = cfg.dtype or leaf.dtype
+            a = (
+                jax.random.normal(k, (kh, kw, cin, cfg.r), jnp.float32)
+                / ((kh * kw * cin) ** 0.5)
+            ).astype(dt)
+            b = jnp.zeros((cfg.r, cout), dt)
             adapters[path] = {"a": a, "b": b}
         return adapters
 
@@ -158,6 +199,19 @@ class LoraModel:
                 "a": P(*stack_p, in_p, None),
                 "b": P(*stack_p, None, *out_p),
             }
+        conv_specs = (
+            _iter_targets(self.base.specs(), self.lora_config.conv_target_modules)
+            if self.lora_config.conv_target_modules
+            else {}
+        )
+        for path, spec in conv_specs.items():
+            # HWIO: A inherits the input-channel sharding, B the output-
+            # channel sharding (OutputChannelParallelConv2d shards O)
+            parts = list(spec) + [None] * (4 - len(spec))
+            out[path] = {
+                "a": P(None, None, parts[2], None),
+                "b": P(None, parts[3]),
+            }
         return out
 
     # -- forward ----------------------------------------------------------
@@ -167,9 +221,20 @@ class LoraModel:
         layer.py:86-119). Built inside jit: XLA fuses the add into consumers."""
         scale = self.lora_config.scaling
         flat_targets = dict(self._targets)
+        conv_targets = dict(self._conv_targets)
 
         def visit(path, leaf):
             key = "/".join(str(getattr(k, "key", k)) for k in path)
+            if key in conv_targets and key in adapters:
+                ab = adapters[key]
+                # HWIO delta: spatial-kernel A x 1x1 B (reference LoraConv2d
+                # merge semantics, layer.py:86-119 applied to conv weights)
+                delta = jnp.einsum(
+                    "hwir,ro->hwio",
+                    ab["a"].astype(jnp.float32),
+                    ab["b"].astype(jnp.float32),
+                )
+                return leaf + (scale * delta).astype(leaf.dtype)
             if key in flat_targets and key in adapters:
                 ab = adapters[key]
                 a, b = ab["a"], ab["b"]

@@ -206,3 +206,146 @@ def test_expert_weights_refused(base):
     for target in (r"experts/gate_up$", r"experts/down$"):
         with pytest.raises(ValueError, match="not LoRA-targetable"):
             LoraModel(model, params, LoraConfig(r=2, target_modules=(target,)))
+
+
+# ---------------------------------------------------------------------------
+# Conv2d targets (reference LoraConv2d, modules/lora/layer.py:334) + serving
+# ---------------------------------------------------------------------------
+
+def _tiny_mllama():
+    from neuronx_distributed_llama3_2_tpu.models.mllama import (
+        MllamaConfig,
+        MllamaForConditionalGeneration,
+        MllamaTextConfig,
+        MllamaVisionConfig,
+    )
+
+    cfg = MllamaConfig(
+        vision=MllamaVisionConfig(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_global_layers=1, attention_heads=2, image_size=28,
+            patch_size=14, max_num_tiles=2, max_aspect_ratio_id=3,
+            intermediate_layers_indices=(0, 1),
+        ),
+        text=MllamaTextConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_heads=4, num_kv_heads=2,
+            cross_attention_layers=(1,), rope_theta=10000.0, max_seq_len=64,
+        ),
+    )
+    return MllamaForConditionalGeneration(cfg)
+
+
+def test_conv_target_vision_lora_parity():
+    """Vision LoRA on the Mllama patch conv: merged kernel == base +
+    scale·(A ⊛ B) with A carrying the spatial kernel and B the 1×1 mix —
+    the reference LoraConv2d factorization (layer.py:334)."""
+    model = _tiny_mllama()
+    params = model.init(jax.random.key(0))
+    cfg = LoraConfig(
+        r=4,
+        alpha=8.0,
+        target_modules=(r"layers/0/attn/qkv/q_kernel$",),
+        conv_target_modules=(r"vision_model/patch_embedding/kernel$",),
+    )
+    lm = LoraModel(model, params, cfg)
+    adapters = lm.init(jax.random.key(1))
+    conv_path = next(p for p in adapters if "patch_embedding" in p)
+    kh, kw, cin, cout = 14, 14, 3, 32
+    assert adapters[conv_path]["a"].shape == (kh, kw, cin, 4)
+    assert adapters[conv_path]["b"].shape == (4, cout)
+
+    # B = 0 ⇒ merged == base exactly
+    merged0 = lm.merged_params(adapters)
+    base_kernel = params["vision_model"]["patch_embedding"]["kernel"]
+    np.testing.assert_array_equal(
+        np.asarray(merged0["vision_model"]["patch_embedding"]["kernel"]),
+        np.asarray(base_kernel),
+    )
+
+    # non-zero B ⇒ merged == base + scaling·einsum(hwir,ro)
+    adapters[conv_path]["b"] = (
+        jax.random.normal(jax.random.key(2), (4, cout), jnp.float32) * 0.1
+    ).astype(adapters[conv_path]["b"].dtype)
+    merged = lm.merged_params(adapters)
+    want = np.asarray(base_kernel, np.float32) + cfg.scaling * np.einsum(
+        "hwir,ro->hwio",
+        np.asarray(adapters[conv_path]["a"], np.float32),
+        np.asarray(adapters[conv_path]["b"], np.float32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(merged["vision_model"]["patch_embedding"]["kernel"]),
+        want, atol=1e-5, rtol=1e-5,
+    )
+    # the q-kernel linear target coexists with the conv target
+    assert any("attn/qkv/q_kernel" in p for p in adapters)
+
+
+def test_conv_target_requires_rank4():
+    model = LlamaForCausalLM(TINY)
+    params = model.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="rank-4"):
+        LoraModel(
+            model, params,
+            LoraConfig(
+                target_modules=(r"qkv/q_kernel$",),
+                conv_target_modules=(r"attn/o/kernel$",),
+            ),
+        )
+
+
+def test_conv_and_linear_pattern_overlap_refused():
+    model = _tiny_mllama()
+    params = model.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="both"):
+        LoraModel(
+            model, params,
+            LoraConfig(
+                target_modules=(r"patch_embedding/kernel$",),
+                conv_target_modules=(r"patch_embedding/kernel$",),
+            ),
+        )
+
+
+def test_decode_with_merged_lora_adapters(base):
+    """Serving merged-LoRA params (reference merge-for-inference flow,
+    lora/model.py:357): zero-B adapters decode identically to the base;
+    trained (non-zero) adapters change the output stream."""
+    from neuronx_distributed_llama3_2_tpu.inference.engine import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+        SamplingConfig,
+    )
+    from neuronx_distributed_llama3_2_tpu.lora.model import merge_lora
+
+    model, params = base
+    cfg = LoraConfig(r=4, alpha=8.0)
+    lm = LoraModel(model, params, cfg)
+    adapters = lm.init(jax.random.key(3))
+    gen = GenerationConfig(
+        max_new_tokens=8, sampling=SamplingConfig(greedy=True)
+    )
+    prompt = list(range(1, 9))
+
+    ref = InferenceEngine(TINY, params, max_batch=1, max_seq_len=64).generate(
+        [prompt], gen
+    ).sequences[0]
+    merged0 = merge_lora(model, params, adapters, cfg)
+    got0 = InferenceEngine(TINY, merged0, max_batch=1, max_seq_len=64).generate(
+        [prompt], gen
+    ).sequences[0]
+    assert got0 == ref  # B=0: adapters are exactly inert in serving
+
+    # non-trivial adapters flow through the decode path
+    adapters = jax.tree.map(
+        lambda x: jax.random.normal(jax.random.key(5), x.shape, jnp.float32)
+        .astype(x.dtype) * 0.3,
+        adapters,
+    )
+    merged1 = merge_lora(model, params, adapters, cfg)
+    got1 = InferenceEngine(TINY, merged1, max_batch=1, max_seq_len=64).generate(
+        [prompt], gen
+    ).sequences[0]
+    assert got1 != ref
