@@ -84,6 +84,12 @@ def main():
     logger = get_logger()
     entry = resolve_model(args.model)
     config = entry["config"]
+    if type(config).__name__ == "MllamaConfig":
+        raise SystemExit(
+            f"{args.model}: multimodal decode needs image inputs; use "
+            f"inference.MllamaDecoder from the library instead of this "
+            f"text-only CLI."
+        )
 
     tokenizer = None
     if args.hf_dir:

@@ -156,6 +156,12 @@ def main():
     # here one script serves the whole registry)
     entry = resolve_model(args.model)
     model_cfg = entry["config"]
+    if type(model_cfg).__name__ == "MllamaConfig":
+        raise SystemExit(
+            f"{args.model}: the vision family needs image inputs; this "
+            f"text-pretraining CLI does not drive it. Use the library "
+            f"(models/mllama.py + trainer) for vision fine-tunes."
+        )
     is_bert = not hasattr(model_cfg, "max_seq_len")
     if is_bert:
         # BERT: fixed learned position table + MLM objective (masking below)

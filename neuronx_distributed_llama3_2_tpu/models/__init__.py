@@ -34,11 +34,13 @@ from neuronx_distributed_llama3_2_tpu.models.gptneox import (  # noqa: F401
     params_to_hf_neox,
 )
 from neuronx_distributed_llama3_2_tpu.models.mllama import (  # noqa: F401
+    MLLAMA_CONFIGS,
     MllamaConfig,
     MllamaForConditionalGeneration,
     MllamaTextConfig,
     MllamaVisionConfig,
     mllama_params_from_hf,
+    mllama_params_to_hf,
 )
 from neuronx_distributed_llama3_2_tpu.models.llama import (  # noqa: F401
     params_from_hf,
@@ -83,6 +85,11 @@ def model_registry():
         reg[name] = {
             "config": cfg, "model_cls": BertForPreTraining,
             "from_hf": params_from_hf_bert, "to_hf": params_to_hf_bert,
+        }
+    for name, cfg in MLLAMA_CONFIGS.items():
+        reg[name] = {
+            "config": cfg, "model_cls": MllamaForConditionalGeneration,
+            "from_hf": mllama_params_from_hf, "to_hf": mllama_params_to_hf,
         }
     return reg
 

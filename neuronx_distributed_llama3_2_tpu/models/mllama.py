@@ -145,6 +145,35 @@ class MllamaConfig:
     text: MllamaTextConfig = MllamaTextConfig()
 
 
+MLLAMA_CONFIGS: Dict[str, MllamaConfig] = {
+    # HF meta-llama/Llama-3.2-11B-Vision config.json: the dataclass defaults
+    # above ARE the 11B values; the text tower adds the llama3 rope scaling
+    # (factor 8, low 1, high 4, original 8192) and bf16 compute
+    "llama3.2-11b-vision": MllamaConfig(
+        vision=dataclasses.replace(MllamaVisionConfig(), dtype=jnp.bfloat16),
+        text=dataclasses.replace(
+            MllamaTextConfig(),
+            rope_scaling=(8.0, 1.0, 4.0, 8192),
+            max_seq_len=131072,
+            dtype=jnp.bfloat16,
+        ),
+    ),
+    "tiny-mllama": MllamaConfig(
+        vision=MllamaVisionConfig(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_global_layers=1, attention_heads=2, image_size=28,
+            patch_size=14, max_num_tiles=2, max_aspect_ratio_id=3,
+            intermediate_layers_indices=(0, 1),
+        ),
+        text=MllamaTextConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_heads=4, num_kv_heads=2,
+            cross_attention_layers=(1,), rope_theta=10000.0, max_seq_len=64,
+        ),
+    ),
+}
+
+
 # ---------------------------------------------------------------------------
 # small building blocks
 # ---------------------------------------------------------------------------
@@ -1002,3 +1031,108 @@ def _hf_mlp(t, pre):
         "gate_up": jnp.asarray(np.stack([gate, up], axis=1)),  # (H, 2, I)
         "down": {"kernel": jnp.asarray(t(pre + "mlp.down_proj.weight").T)},
     }
+
+
+def mllama_params_to_hf(params: Params, config: MllamaConfig) -> Dict[str, Any]:
+    """Inverse of :func:`mllama_params_from_hf`: pytree → HF Mllama state
+    dict (numpy fp32, torch layouts — Linear (out, in), conv OIHW).
+    Completes the native→HF direction for the vision family (reference
+    converter role, scripts/checkpoint_converter.py:685)."""
+    import numpy as np
+
+    def np32(x):
+        return np.asarray(x, dtype=np.float32)
+
+    sd: Dict[str, Any] = {}
+
+    def put_lin(name, p):
+        sd[name + ".weight"] = np32(p["kernel"]).T
+        if "bias" in p:
+            sd[name + ".bias"] = np32(p["bias"])
+
+    def put_ln(name, p):
+        sd[name + ".weight"] = np32(p["scale"])
+        if "bias" in p:
+            sd[name + ".bias"] = np32(p["bias"])
+
+    vp = "model.vision_model."
+    vis = params["vision_model"]
+    # HWIO → torch OIHW
+    sd[vp + "patch_embedding.weight"] = np.transpose(
+        np32(vis["patch_embedding"]["kernel"]), (3, 2, 0, 1)
+    )
+    sd[vp + "class_embedding"] = np32(vis["class_embedding"])
+    gpe = vis["gated_positional_embedding"]
+    sd[vp + "gated_positional_embedding.embedding"] = np32(gpe["embedding"])
+    sd[vp + "gated_positional_embedding.tile_embedding.weight"] = np32(
+        gpe["tile_embedding"]
+    )
+    sd[vp + "gated_positional_embedding.gate"] = np32(gpe["gate"]).reshape(1)
+    for which in ("pre", "post"):
+        tpe = vis[f"{which}_tile_positional_embedding"]
+        sd[vp + f"{which}_tile_positional_embedding.embedding.weight"] = np32(
+            tpe["embedding"]
+        )
+        sd[vp + f"{which}_tile_positional_embedding.gate"] = np32(
+            tpe["gate"]
+        ).reshape(1)
+    put_ln(vp + "layernorm_pre", vis["layernorm_pre"])
+    put_ln(vp + "layernorm_post", vis["layernorm_post"])
+
+    def put_vis_layer(prefix, p, gated):
+        put_ln(prefix + "input_layernorm", p["input_layernorm"])
+        for k in ("q", "k", "v", "o"):
+            put_lin(prefix + f"self_attn.{k}_proj", p["self_attn"][k])
+        put_ln(
+            prefix + "post_attention_layernorm", p["post_attention_layernorm"]
+        )
+        put_lin(prefix + "mlp.fc1", p["mlp"]["fc1"])
+        put_lin(prefix + "mlp.fc2", p["mlp"]["fc2"])
+        if gated:
+            sd[prefix + "gate_attn"] = np32(p["gate_attn"]).reshape(1)
+            sd[prefix + "gate_ffn"] = np32(p["gate_ffn"]).reshape(1)
+
+    for i, p in enumerate(vis["transformer"]):
+        put_vis_layer(f"{vp}transformer.layers.{i}.", p, gated=False)
+    for i, p in enumerate(vis["global_transformer"]):
+        put_vis_layer(f"{vp}global_transformer.layers.{i}.", p, gated=True)
+
+    def put_mlp(pre, mlp):
+        gate_up = np32(mlp["gate_up"])  # (H, 2, I)
+        sd[pre + "mlp.gate_proj.weight"] = gate_up[:, 0, :].T
+        sd[pre + "mlp.up_proj.weight"] = gate_up[:, 1, :].T
+        sd[pre + "mlp.down_proj.weight"] = np32(mlp["down"]["kernel"]).T
+
+    tp_ = "model.language_model."
+    tc = config.text
+    for i, p in enumerate(params["layers"]):
+        pre = f"{tp_}layers.{i}."
+        if i in tc.cross_attention_layers:
+            put_ln(pre + "input_layernorm", p["input_layernorm"])
+            for k in ("q", "k", "v", "o"):
+                put_lin(pre + f"cross_attn.{k}_proj", p["cross_attn"][k])
+            put_ln(pre + "cross_attn.q_norm", p["cross_attn"]["q_norm"])
+            put_ln(pre + "cross_attn.k_norm", p["cross_attn"]["k_norm"])
+            sd[pre + "cross_attn_attn_gate"] = np32(
+                p["cross_attn_attn_gate"]
+            ).reshape(1)
+            sd[pre + "cross_attn_mlp_gate"] = np32(
+                p["cross_attn_mlp_gate"]
+            ).reshape(1)
+            put_ln(pre + "post_attention_layernorm", p["post_attention_layernorm"])
+            put_mlp(pre, p["mlp"])
+        else:
+            put_ln(pre + "input_layernorm", p["attn_norm"])
+            qkv = p["attn"]["qkv"]
+            sd[pre + "self_attn.q_proj.weight"] = np32(qkv["q_kernel"]).T
+            sd[pre + "self_attn.k_proj.weight"] = np32(qkv["k_kernel"]).T
+            sd[pre + "self_attn.v_proj.weight"] = np32(qkv["v_kernel"]).T
+            put_lin(pre + "self_attn.o_proj", p["attn"]["o"])
+            put_ln(pre + "post_attention_layernorm", p["mlp_norm"])
+            put_mlp(pre, p["mlp"])
+
+    put_lin("model.multi_modal_projector", params["multi_modal_projector"])
+    sd[tp_ + "embed_tokens.weight"] = np32(params["embed"]["embedding"])
+    put_ln(tp_ + "norm", params["final_norm"])
+    put_lin("lm_head", params["lm_head"])
+    return sd

@@ -87,7 +87,12 @@ def save_hf_state_dict(sd: Dict[str, Any], path: str, config) -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    dtype = np.dtype(config.dtype) if config.dtype != jnp.bfloat16 else jnp.bfloat16
+    # MllamaConfig nests its dtype under text/vision; every other family
+    # carries a top-level dtype
+    cfg_dtype = getattr(config, "dtype", None)
+    if cfg_dtype is None:
+        cfg_dtype = config.text.dtype
+    dtype = np.dtype(cfg_dtype) if cfg_dtype != jnp.bfloat16 else jnp.bfloat16
     itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
     _write_sharded_safetensors(
         sd,
@@ -145,6 +150,64 @@ def _hf_config_dict(config) -> Dict[str, Any]:
     import jax.numpy as jnp
 
     name = type(config).__name__
+    if name == "MllamaConfig":
+        v, t = config.vision, config.text
+        text_cfg = {
+            "vocab_size": t.vocab_size,
+            "hidden_size": t.hidden_size,
+            "intermediate_size": t.intermediate_size,
+            "num_hidden_layers": t.num_hidden_layers,
+            "num_attention_heads": t.num_heads,
+            "num_key_value_heads": t.num_kv_heads,
+            "cross_attention_layers": list(t.cross_attention_layers),
+            "rope_theta": t.rope_theta,
+            "rms_norm_eps": t.rms_norm_eps,
+            "max_position_embeddings": t.max_seq_len,
+        }
+        if t.rope_scaling is not None:
+            factor, low, high, orig = t.rope_scaling
+            text_cfg["rope_scaling"] = {
+                "rope_type": "llama3",
+                "factor": factor,
+                "low_freq_factor": low,
+                "high_freq_factor": high,
+                "original_max_position_embeddings": orig,
+            }
+        return {
+            "architectures": ["MllamaForConditionalGeneration"],
+            "model_type": "mllama",
+            "text_config": text_cfg,
+            "vision_config": {
+                "hidden_size": v.hidden_size,
+                "intermediate_size": v.intermediate_size,
+                "num_hidden_layers": v.num_hidden_layers,
+                "num_global_layers": v.num_global_layers,
+                "attention_heads": v.attention_heads,
+                "image_size": v.image_size,
+                "patch_size": v.patch_size,
+                "num_channels": v.num_channels,
+                "max_num_tiles": v.max_num_tiles,
+                # transformers derives max_aspect_ratio_id from this list
+                # (a read-only property there — emitting the id directly
+                # crashes PretrainedConfig setattr); HF enumeration order:
+                # width-major over width*height <= max_num_tiles
+                "supported_aspect_ratios": [
+                    [w, h]
+                    for w in range(1, v.max_num_tiles + 1)
+                    for h in range(1, v.max_num_tiles + 1)
+                    if w * h <= v.max_num_tiles
+                ],
+                # derived on our side (hidden * (1 + collected layers)) but
+                # an independent field in HF — omitting it would build the
+                # projector at the 11B default 7680 for every other size
+                "vision_output_dim": v.output_dim,
+                "intermediate_layers_indices": list(
+                    v.intermediate_layers_indices
+                ),
+                "norm_eps": v.norm_eps,
+            },
+            "torch_dtype": str(jnp.dtype(t.dtype)),
+        }
     if name == "BertConfig":
         return {
             "architectures": ["BertForPreTraining"],

@@ -407,3 +407,100 @@ def test_exported_config_json_loads_in_transformers():
     assert hc.num_local_experts == cfg.num_experts
     assert hc.num_experts_per_tok == cfg.top_k
     assert hc.num_key_value_heads == cfg.num_kv_heads
+
+
+def test_mllama_to_hf_roundtrip():
+    """Vision family (beyond-reference) round-trips both directions: to_hf
+    values match the HF state dict bit-exactly, and from_hf(to_hf(params))
+    is the identity."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_mllama import TINY as MLLAMA_TINY, _hf_tiny
+
+    from neuronx_distributed_llama3_2_tpu.models.mllama import (
+        mllama_params_from_hf,
+        mllama_params_to_hf,
+    )
+
+    hf = _hf_tiny()
+    sd = {
+        k: v.detach().numpy().astype(np.float32)
+        for k, v in hf.state_dict().items()
+    }
+    params = mllama_params_from_hf(sd, MLLAMA_TINY)
+    back = mllama_params_to_hf(params, MLLAMA_TINY)
+    assert set(back) == set(sd)  # every HF tensor exported, none extra
+    for k, v in back.items():
+        assert np.asarray(v).shape == np.asarray(sd[k]).shape, k
+        np.testing.assert_allclose(np.asarray(v), sd[k], atol=1e-6, err_msg=k)
+    again = mllama_params_from_hf(back, MLLAMA_TINY)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(again)[0],
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            err_msg=str(pa),
+        )
+
+
+def test_mllama_config_json():
+    from neuronx_distributed_llama3_2_tpu.models import MLLAMA_CONFIGS
+    from neuronx_distributed_llama3_2_tpu.scripts.checkpoint_converter import (
+        _hf_config_dict,
+    )
+
+    d = _hf_config_dict(MLLAMA_CONFIGS["llama3.2-11b-vision"])
+    assert d["model_type"] == "mllama"
+    assert d["text_config"]["num_hidden_layers"] == 40
+    assert d["text_config"]["rope_scaling"]["factor"] == 8.0
+    assert d["vision_config"]["max_num_tiles"] == 4
+
+
+def test_mllama_vision_config_loads_in_transformers():
+    """Review finding: max_aspect_ratio_id is a read-only property on HF's
+    MllamaVisionConfig — the export must carry supported_aspect_ratios and
+    vision_output_dim instead, and they must reproduce our derived values."""
+    from transformers.models.mllama.configuration_mllama import (
+        MllamaVisionConfig as HFVision,
+    )
+
+    from neuronx_distributed_llama3_2_tpu.models import MLLAMA_CONFIGS
+    from neuronx_distributed_llama3_2_tpu.scripts.checkpoint_converter import (
+        _hf_config_dict,
+    )
+
+    for name in ("llama3.2-11b-vision", "tiny-mllama"):
+        ours = MLLAMA_CONFIGS[name].vision
+        d = _hf_config_dict(MLLAMA_CONFIGS[name])["vision_config"]
+        hv = HFVision(**d)
+        assert hv.max_aspect_ratio_id == ours.max_aspect_ratio_id, name
+        assert hv.vision_output_dim == ours.output_dim, name
+        assert hv.num_global_layers == ours.num_global_layers, name
+
+
+def test_cli_refuses_mllama_for_text_only_entrypoints():
+    """generate.py / pretrain_llama.py give mllama keys a clean refusal
+    instead of an AttributeError traceback (review finding)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "generate.py"),
+         "--model", "tiny-mllama", "--prompt-ids", "1,2,3",
+         "--random-init", "--cpu-devices", "2"],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode != 0
+    assert "multimodal decode needs image inputs" in (r.stderr + r.stdout)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "pretrain_llama.py"),
+         "--model", "tiny-mllama", "--ckpt-dir", "/tmp/nope",
+         "--synthetic", "1000", "--steps", "1", "--cpu-devices", "2"],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode != 0
+    assert "vision family needs image inputs" in (r.stderr + r.stdout)
