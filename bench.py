@@ -36,19 +36,22 @@ def main():
         train_flops_per_token,
     )
 
+    # env knobs let scripts/mfu_sweep.py probe alternatives in bounded
+    # subprocesses; the committed defaults are the tuned values
+    env_int = lambda k, d: int(os.environ.get(k, d))  # noqa: E731
     model_cfg = dataclasses.replace(
         LLAMA_CONFIGS["llama3.2-1b"],
-        remat="full",
+        remat=os.environ.get("BENCH_REMAT", "full"),
         max_seq_len=2048,
         use_flash_attention=True,
         # tuned on v5e: large flash tiles amortize Mosaic per-program
         # overhead (sweep: 256x512 -> 41.7%, 1024x1024 -> 46.0% MFU);
         # chunk 256 beats 512 by ~1 point on the fused CE
-        flash_block_q=1024,
-        flash_block_kv=1024,
-        loss_chunk_size=256,
+        flash_block_q=env_int("BENCH_FLASH_BQ", 1024),
+        flash_block_kv=env_int("BENCH_FLASH_BKV", 1024),
+        loss_chunk_size=env_int("BENCH_LOSS_CHUNK", 256),
     )
-    batch, seq = 12, 2048
+    batch, seq = env_int("BENCH_BATCH", 12), 2048
 
     # Single-chip 1B: pure-bf16 optimizer (no fp32 master — 12 bytes/param of
     # AdamW state does not fit 16G HBM next to the model; multi-chip ZeRO-1

@@ -125,6 +125,7 @@ def test_tp_sharded_parity(params):
         parallel_state.destroy_model_parallel()
 
 
+@pytest.mark.slow
 def test_mlm_train_step():
     from neuronx_distributed_llama3_2_tpu.trainer import (
         OptimizerConfig,

@@ -100,6 +100,7 @@ def test_async_save(tmp_path):
     _tree_eq(out["model"], tree)
 
 
+@pytest.mark.slow
 def test_train_resume_and_reshard(tmp_path):
     """Save under tp=2, resume under tp=4 (elastic resharding — the
     reference needs the offline checkpoint_converter CLI for this), training

@@ -55,17 +55,22 @@ def oracle():
 
 
 # the reference's var=value combo files, spelled as parametrize ids
+# default tier keeps one combo per dimension (tp+sp, grad-accum, pp, 1f1b);
+# the full matrix runs in the opt-in slow tier (pytest -m slow) — same
+# split the reference makes between per-PR tests and its combinatorial
+# integration matrix (SURVEY §4)
+_S = pytest.mark.slow
 COMBOS = [
     # (tp, sp, pp, zero1, microbatches, remat, schedule)
     ("TP2_SP0_PP1_Z0_MB1", 2, False, 1, False, 1, "none", None),
-    ("TP2_SP1_PP1_Z1_MB1", 2, True, 1, True, 1, "none", None),
-    ("TP4_SP1_PP1_Z1_MB1", 4, True, 1, True, 1, "none", None),
+    pytest.param("TP2_SP1_PP1_Z1_MB1", 2, True, 1, True, 1, "none", None, marks=_S),
+    pytest.param("TP4_SP1_PP1_Z1_MB1", 4, True, 1, True, 1, "none", None, marks=_S),
     ("TP1_SP0_PP1_Z1_MB2", 1, False, 1, True, 2, "none", None),
-    ("TP1_SP0_PP1_Z1_MB4", 1, False, 1, True, 4, "none", None),
-    ("TP1_SP0_PP2_Z1_MB1", 1, False, 2, True, 1, "none", "gpipe"),
-    ("TP2_SP1_PP2_Z1_MB1", 2, True, 2, True, 1, "none", "gpipe"),
+    pytest.param("TP1_SP0_PP1_Z1_MB4", 1, False, 1, True, 4, "none", None, marks=_S),
+    pytest.param("TP1_SP0_PP2_Z1_MB1", 1, False, 2, True, 1, "none", "gpipe", marks=_S),
+    pytest.param("TP2_SP1_PP2_Z1_MB1", 2, True, 2, True, 1, "none", "gpipe", marks=_S),
     ("TP2_SP1_PP2_Z0_1F1B", 2, True, 2, False, 1, "none", "1f1b"),
-    ("TP2_SP1_PP1_Z1_SC", 2, True, 1, True, 1, "selective", None),
+    pytest.param("TP2_SP1_PP1_Z1_SC", 2, True, 1, True, 1, "selective", None, marks=_S),
     ("TP2_SP1_PP1_Z1_FULLRM", 2, True, 1, True, 1, "full", None),
 ]
 
@@ -73,7 +78,9 @@ COMBOS = [
 @pytest.mark.parametrize(
     "name,tp,sp,pp,zero1,mb,remat,schedule",
     COMBOS,
-    ids=[c[0] for c in COMBOS],
+    ids=[
+        (c.values[0] if hasattr(c, "values") else c[0]) for c in COMBOS
+    ],
 )
 def test_combo_matches_oracle(oracle, name, tp, sp, pp, zero1, mb, remat, schedule):
     want_loss, want_gn = oracle

@@ -193,6 +193,7 @@ def test_cli_unknown_model_lists_choices():
         ])
 
 
+@pytest.mark.slow
 def test_generate_cli_arg_validation():
     """examples/generate.py argument paths: unknown model lists choices,
     BERT is refused by the decode dispatcher, missing prompt errors, and
@@ -413,9 +414,7 @@ def test_mllama_to_hf_roundtrip():
     """Vision family (beyond-reference) round-trips both directions: to_hf
     values match the HF state dict bit-exactly, and from_hf(to_hf(params))
     is the identity."""
-    import sys, os
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from test_mllama import TINY as MLLAMA_TINY, _hf_tiny
+    from tests.test_mllama import TINY as MLLAMA_TINY, _hf_tiny
 
     from neuronx_distributed_llama3_2_tpu.models.mllama import (
         mllama_params_from_hf,

@@ -55,6 +55,7 @@ def test_loader_epoch_reshuffle(token_file):
     assert not np.array_equal(first_epoch0, first_epoch1)
 
 
+@pytest.mark.slow
 def test_pretrain_script_resume(tmp_path):
     """Two invocations: train 4 steps + save, then resume and finish — the
     reference's latest_if_exists resume flow (run_llama_nxd.py:204-239),

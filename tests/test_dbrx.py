@@ -136,6 +136,7 @@ def test_dbrx_clip_qkv_matters(dbrx_params):
     assert not np.allclose(a, b)
 
 
+@pytest.mark.slow
 def test_dbrx_trains():
     cfg = TINY
     model = DbrxForCausalLM(cfg)

@@ -176,7 +176,11 @@ def test_pipelined_neox_matches_unpipelined():
         parallel_state.destroy_model_parallel()
 
 
-@pytest.mark.parametrize("cfg", [TINY_NEOX, TINY_CODEGEN], ids=["neox", "codegen"])
+@pytest.mark.parametrize(
+    "cfg",
+    [TINY_NEOX, pytest.param(TINY_CODEGEN, marks=pytest.mark.slow)],
+    ids=["neox", "codegen"],
+)
 def test_1f1b_neox_loss_and_grad_parity(cfg):
     """GPT-NeoX/CodeGen through the 1F1B manual-VJP executor: loss+grads
     match unpipelined autodiff (partial rotary in both conventions, shared

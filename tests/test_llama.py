@@ -121,6 +121,7 @@ def test_scan_equals_unrolled(hf_model, batch):
     )
 
 
+@pytest.mark.slow
 def test_remat_matches(hf_model, batch):
     import dataclasses
 

@@ -241,6 +241,7 @@ def test_1f1b_through_trainer():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_1f1b_activation_memory_below_gpipe():
     """The point of 1F1B (VERDICT #5 done-condition): peak temp memory under
     the manual schedule stays below GPipe's autodiff-stored streams once M
@@ -366,6 +367,7 @@ def test_moe_pipeline_exact_parity_single_microbatch():
     assert abs(float(loss) - float(ref)) < 1e-4, (float(loss), float(ref))
 
 
+@pytest.mark.slow
 def test_moe_pipeline_trains():
     """pp=2 x ep=2 Mixtral through the trainer: loss decreases, aux>0."""
     from neuronx_distributed_llama3_2_tpu.models.mixtral import (
@@ -399,6 +401,7 @@ def test_moe_pipeline_trains():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_moe_1f1b_matches_gpipe_and_autodiff():
     """MoE under the 1F1B manual-VJP executor: loss AND grads match the
     gpipe (autodiff) executor — the router-aux cotangent path is exact."""
@@ -449,7 +452,7 @@ def test_moe_1f1b_matches_gpipe_and_autodiff():
 
 @pytest.mark.parametrize(
     "tp,ep",
-    [(2, 1), (2, 2)],
+    [(2, 1), pytest.param(2, 2, marks=pytest.mark.slow)],
     ids=["tp2", "tp2_ep2"],
 )
 def test_moe_1f1b_tp_ep_matches_gpipe(tp, ep):
@@ -571,7 +574,10 @@ def test_rotation_plan_bubble_shrinks_with_chunks():
     assert units[2] < units[1] and units[4] < units[2]
 
 
-@pytest.mark.parametrize("pp,V,M", [(2, 2, 4), (2, 2, 6)])
+@pytest.mark.parametrize(
+    "pp,V,M",
+    [(2, 2, 4), pytest.param(2, 2, 6, marks=pytest.mark.slow)],
+)
 def test_interleaved_executor_matches_unpipelined(pp, V, M):
     """Chunked-rotation executor: loss == unpipelined model, grads finite
     and matching gpipe's."""
@@ -640,6 +646,7 @@ def test_interleaved_loss_and_grad_refused():
         parallel_state.destroy_model_parallel()
 
 
+@pytest.mark.slow
 def test_interleaved_via_pretrain_cli(tmp_path):
     """TrainingConfig/CLI wiring (VERDICT r2 item 3): the pretrain example
     runs the interleaved executor end-to-end via --pp-schedule interleaved

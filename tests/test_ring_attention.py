@@ -120,6 +120,7 @@ def test_llama_forward_with_cp():
     assert abs(float(loss) - float(ref_loss)) < 1e-4
 
 
+@pytest.mark.slow
 def test_llama_train_step_with_cp():
     """cp=2 training through the trainer facade: grads match cp=1."""
     from neuronx_distributed_llama3_2_tpu.trainer import (

@@ -100,6 +100,7 @@ def test_zero1_specs():
     assert off.mu == pspecs
 
 
+@pytest.mark.slow
 def test_grad_accum_equivalence():
     """num_microbatches=4 produces the same step as one full batch
     (reference grad-accum semantics)."""
