@@ -170,28 +170,37 @@ class InferenceEngine:
 
     # -- program table ----------------------------------------------------
 
+    def prefill_compute(self, params, cache, ids, lengths, slots, key, cfg):
+        """The context-encode computation: bucket-causal forward,
+        last-valid-token gather, LM head on that single position, on-device
+        sample. Traced by :meth:`_prefill_program` AND by
+        ``runner.benchmark_prefill_on_device`` — one body, so the benchmark
+        can never drift from what serving executes. Returns
+        (tokens, logits, cache)."""
+        model = self.model
+        params = self._live_params(params)
+        positions = jnp.zeros((ids.shape[0],), jnp.int32)
+        hidden, cache = model.forward(
+            params, cache, ids, positions, slots,
+            context_encode=True, return_hidden=True,
+        )
+        # last-token gather before the LM head (model_base.py:444-452)
+        last = jnp.take_along_axis(
+            hidden, (lengths - 1)[:, None, None], axis=1
+        )  # (b, 1, H)
+        logits = model._model()._logits(params, last)[:, 0, :]
+        tokens = sample(logits, key, cfg)
+        return tokens, logits, cache
+
     def _prefill_program(self, batch: int, bucket: int, cfg: SamplingConfig):
-        """Context-encode program: bucket-causal forward, last-valid-token
-        gather, LM head on that single position, on-device sample."""
         key_ = ("prefill", batch, bucket, cfg)
         if key_ in self._programs:
             return self._programs[key_]
-        model = self.model
 
         def prefill(params, cache, ids, lengths, slots, key):
-            params = self._live_params(params)
-            positions = jnp.zeros((ids.shape[0],), jnp.int32)
-            hidden, cache = model.forward(
-                params, cache, ids, positions, slots,
-                context_encode=True, return_hidden=True,
+            return self.prefill_compute(
+                params, cache, ids, lengths, slots, key, cfg
             )
-            # last-token gather before the LM head (model_base.py:444-452)
-            last = jnp.take_along_axis(
-                hidden, (lengths - 1)[:, None, None], axis=1
-            )  # (b, 1, H)
-            logits = model._model()._logits(params, last)[:, 0, :]
-            tokens = sample(logits, key, cfg)
-            return tokens, logits, cache
 
         fn = jax.jit(prefill, donate_argnums=(1,))
         self._programs[key_] = fn
