@@ -47,7 +47,6 @@ from neuronx_distributed_llama3_2_tpu.models.llama import (
     _head_axis,
     apply_rope,
     make_norm,
-    precompute_rope,
 )
 from neuronx_distributed_llama3_2_tpu.parallel.layers import (
     BATCH_AXES,
@@ -85,6 +84,12 @@ class LlamaDecode:
 
     def _model(self) -> LlamaForCausalLM:
         return LlamaForCausalLM(self.config)
+
+    def _rope_tables(self, max_len: int):
+        """Rotary tables sized for the cache — delegated to the training
+        model's ``_rope`` hook so per-family rope semantics (partial rotary,
+        scaling) have exactly one source (llama.py:631, gptneox.py _rope)."""
+        return self._model()._rope(max_len)
 
     # -- cache ------------------------------------------------------------
 
@@ -167,9 +172,7 @@ class LlamaDecode:
             pos_block = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
         else:
             pos_block = positions[:, None] + tree[0][None, :]
-        sin, cos = precompute_rope(
-            c.head_dim, cache.max_len, c.rope_theta, c.rope_scaling
-        )
+        sin, cos = self._rope_tables(cache.max_len)
 
         x = model._embed()(params["embed"], tokens)
         x = constrain(x, P(BATCH_AXES, None, None))
@@ -233,11 +236,32 @@ class LlamaDecode:
         q = apply_rope(q, sin, cos, pos_block)
         k = apply_rope(k, sin, cos, pos_block)
 
+        att, kc, vc = self._attend_with_cache(
+            q, k, v, kc, vc, slots, pos_block, positions,
+            context_encode=context_encode, tree=tree, kv_limit=kv_limit,
+        )
+        att = att.reshape(b, t, c.num_heads * c.head_dim)
+        x = x + attn._o()(lp["attn"]["o"], att)
+        h = norm(lp["mlp_norm"], x)
+        x = x + self._mlp_block(lp, h)
+        return x, kc, vc
+
+    def _attend_with_cache(
+        self, q, k, v, kc, vc, slots, pos_block, positions,
+        *, context_encode: bool, tree=None, kv_limit=None,
+    ):
+        """Cache write + attention, shared by every decode family (Llama,
+        MoE, GPT-NeoX): scatter the fresh roped K/V into the cache, then
+        bucket-causal (prefill) or cache attention (token-gen). Returns
+        (att (b,T,N,D), kc, vc)."""
+        c = self.config
+
         # scatter-write the fresh block into the cache at (slot, position) —
         # the reference's position_ids/seq_ids KV scatter (model_base.py:389-419);
         # writes cast to the cache dtype so cache_dtype survives and donation
         # can reuse the buffers. Tree blocks write at consecutive rows
         # (position + i), decoupled from their rope depth in pos_block.
+        t = q.shape[1]
         write_rows = (
             pos_block
             if tree is None
@@ -268,12 +292,7 @@ class LlamaDecode:
             att = self._cache_attention(
                 q, k_all, v_all, pos_block, ha, positions=positions, tree=tree
             )
-
-        att = att.reshape(b, t, c.num_heads * c.head_dim)
-        x = x + attn._o()(lp["attn"]["o"], att)
-        h = norm(lp["mlp_norm"], x)
-        x = x + self._mlp_block(lp, h)
-        return x, kc, vc
+        return att, kc, vc
 
     def _mlp_block(self, lp: Params, h: jax.Array) -> jax.Array:
         """Post-attention feed-forward on the normed hidden (b,T,H).
@@ -363,6 +382,68 @@ class MixtralDecode(LlamaDecode):
         return y
 
 
+@dataclasses.dataclass(frozen=True)
+class GPTNeoXDecode(LlamaDecode):
+    """Decode-mode GPT-NeoX/Pythia/CodeGen: the shared KV-cache machinery
+    (:meth:`LlamaDecode._attend_with_cache`) under the family's block
+    structure — parallel (or Pythia-sequential) residual, LayerNorm with
+    bias, biased projections, partial rotary in either convention.
+    Beyond-reference capability: the reference ships no GPT-NeoX/CodeGen
+    inference model at all (its inference zoo is Llama/Mixtral/DBRX,
+    SURVEY §2.7)."""
+
+    def _model(self):
+        from neuronx_distributed_llama3_2_tpu.models.gptneox import (
+            GPTNeoXForCausalLM,
+        )
+
+        return GPTNeoXForCausalLM(self.config)
+
+    def _decode_layer(
+        self, lp, x, kc, vc, sin, cos, pos_block, positions, slots,
+        *, context_encode: bool, tree=None, kv_limit=None,
+    ):
+        from neuronx_distributed_llama3_2_tpu.models.gptneox import (
+            GPTNeoXAttention,
+            GPTNeoXMLP,
+        )
+
+        c = self.config
+        attn = GPTNeoXAttention(c)
+        norm = make_norm(c)
+        b, t, _ = x.shape
+
+        h1 = norm(lp["attn_norm"], x)
+        q, k, v = attn._qkv()(lp["attn"]["qkv"], h1)
+        if c.clip_qkv is not None:
+            # inherited LlamaConfig knob; the training forward clamps
+            # (llama.py LlamaAttention), so decode must too
+            q = jnp.clip(q, -c.clip_qkv, c.clip_qkv)
+            k = jnp.clip(k, -c.clip_qkv, c.clip_qkv)
+            v = jnp.clip(v, -c.clip_qkv, c.clip_qkv)
+        q = q.reshape(b, t, c.num_heads, c.head_dim)
+        k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
+        v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
+        q, k = attn._apply_rope(q, k, sin, cos, pos_block)
+
+        att, kc, vc = self._attend_with_cache(
+            q, k, v, kc, vc, slots, pos_block, positions,
+            context_encode=context_encode, tree=tree, kv_limit=kv_limit,
+        )
+        att = att.reshape(b, t, c.num_heads * c.head_dim)
+        attn_out = attn._o()(lp["attn"]["o"], att)
+
+        mlp = GPTNeoXMLP(c)
+        if c.parallel_residual:
+            # x + attn(ln1 x) + mlp(ln2 x) — CodeGen shares ln1 (gptneox.py
+            # GPTNeoXDecoderLayer, the single source of the block semantics)
+            h2 = h1 if c.shared_layernorm else norm(lp["mlp_norm"], x)
+            return x + attn_out + mlp(lp["mlp"], h2), kc, vc
+        x = x + attn_out
+        h2 = norm(lp["mlp_norm"], x)
+        return x + mlp(lp["mlp"], h2), kc, vc
+
+
 def decode_model_for(config) -> LlamaDecode:
     """Pick the decode-model class for a training config (the engine-side
     analogue of the reference's per-family NeuronXxxForCausalLM dispatch)."""
@@ -376,13 +457,7 @@ def decode_model_for(config) -> LlamaDecode:
             "use BertForPreTraining's forward directly"
         )
     if isinstance(config, GPTNeoXConfig):
-        # parallel-residual blocks + partial rotary don't match the Llama
-        # decode layer; refusing beats silently-wrong generation (the
-        # reference likewise has no GPT-NeoX/CodeGen inference model)
-        raise NotImplementedError(
-            "KV-cache decode is not implemented for the GPT-NeoX/CodeGen "
-            "family; use the training model's full forward"
-        )
+        return GPTNeoXDecode(config)
     if isinstance(config, MixtralConfig):
         return MixtralDecode(config)
     return LlamaDecode(config)
