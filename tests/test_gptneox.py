@@ -297,3 +297,63 @@ def test_decode_incremental_matches_training_forward():
             np.asarray(full[0, -1], np.float32),
             atol=2e-4, rtol=2e-4,
         )
+
+
+def test_neox_speculative_and_quantized_serving():
+    """The family-agnostic serving layers compose with the new decode:
+    draft-model speculative decoding equals plain greedy, and int8
+    weight-only quantized params serve through the same engine."""
+    from neuronx_distributed_llama3_2_tpu.inference.engine import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+        SamplingConfig,
+    )
+    from neuronx_distributed_llama3_2_tpu.inference.speculative import (
+        SpeculativeDecoder,
+    )
+    from neuronx_distributed_llama3_2_tpu.quantization import quantize_params
+
+    hf = _hf_neox()
+    params = params_from_hf_neox(hf.state_dict(), TINY_NEOX)
+    prompt = list(range(4, 12))
+    gen = GenerationConfig(max_new_tokens=10, sampling=SamplingConfig(greedy=True))
+
+    ref = InferenceEngine(TINY_NEOX, params, max_batch=1, max_seq_len=64).generate(
+        [prompt], gen
+    ).sequences[0]
+
+    # speculative with the same model as draft == greedy, high acceptance
+    target = InferenceEngine(TINY_NEOX, params, max_batch=1, max_seq_len=64)
+    draft = InferenceEngine(TINY_NEOX, params, max_batch=1, max_seq_len=64)
+    res = SpeculativeDecoder(target, draft, gamma=3).generate(
+        prompt, max_new_tokens=10
+    )
+    assert res.tokens == ref
+    assert res.mean_accepted > 2.5
+
+    # int8 weight-only serving: in-jit dequant must equal serving the
+    # host-dequantized tree (identical computation — exact-match guarantee,
+    # the test_quantization.py engine pattern), and the NeoX tree must
+    # actually have been quantized
+    from neuronx_distributed_llama3_2_tpu.quantization import (
+        QuantizedTensor,
+        dequantize_params,
+    )
+
+    qparams = quantize_params(params)
+    n_q = sum(
+        isinstance(l, QuantizedTensor)
+        for l in jax.tree.leaves(
+            qparams, is_leaf=lambda l: isinstance(l, QuantizedTensor)
+        )
+    )
+    assert n_q > 0, "quantize_params matched no NeoX kernels"
+    qengine = InferenceEngine(TINY_NEOX, qparams, max_batch=1, max_seq_len=64)
+    out = qengine.generate([prompt], gen).sequences[0]
+    deq = dequantize_params(qparams, TINY_NEOX.dtype)
+    want = InferenceEngine(TINY_NEOX, deq, max_batch=1, max_seq_len=64).generate(
+        [prompt], gen
+    ).sequences[0]
+    assert out == want
