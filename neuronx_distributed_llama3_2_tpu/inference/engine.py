@@ -147,18 +147,9 @@ class InferenceEngine:
         and the dequant multiply fuses into each consuming matmul — the
         quantized-serving mode of the reference's run_llama_quantized.py,
         where HBM holds int8 weights and the MXU sees bf16."""
-        from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
-            QuantizedTensor,
-            dequantize_params,
-        )
+        from neuronx_distributed_llama3_2_tpu.quantization import live_params
 
-        has_q = any(
-            isinstance(l, QuantizedTensor)
-            for l in jax.tree.leaves(
-                params, is_leaf=lambda l: isinstance(l, QuantizedTensor)
-            )
-        )
-        return dequantize_params(params, self.config.dtype) if has_q else params
+        return live_params(params, self.config.dtype)
 
     def _kv_bucket(self, needed: int) -> int:
         """Token-gen cache bucket covering ``needed`` rows; positions past a

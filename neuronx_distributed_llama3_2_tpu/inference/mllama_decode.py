@@ -59,23 +59,6 @@ class MllamaDecoder:
     the logit-parity gate path; batching rides the same programs)."""
 
     def __init__(self, config: MllamaConfig, params: Params, max_seq_len: int = 512):
-        from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
-            QuantizedTensor,
-        )
-
-        if any(
-            isinstance(l, QuantizedTensor)
-            for l in jax.tree.leaves(
-                params, is_leaf=lambda l: isinstance(l, QuantizedTensor)
-            )
-        ):
-            # this decoder slices params eagerly (precompute_cross_kv) and
-            # its programs don't dequantize in-jit like the text engine's;
-            # refuse rather than crash mid-trace or matmul raw int8
-            raise NotImplementedError(
-                "MllamaDecoder does not support quantized parameter trees; "
-                "pass dequantize_params(qparams, config.text.dtype)"
-            )
         self.config = config
         self.params = params
         self.max_seq_len = max_seq_len
@@ -88,6 +71,24 @@ class MllamaDecoder:
             if i not in config.text.cross_attention_layers
         ]
         self._fwd = jax.jit(self.forward)
+        self._precompute = jax.jit(self._precompute_cross_kv_impl)
+
+    def _live_params(self, params: Params) -> Params:
+        """int8/fp8 trees stay resident; every program dequantizes in-jit so
+        XLA fuses the cast into consumers — the shared serving discipline
+        (quantization.live_params, checked per CALL on the tree passed, not
+        one captured at construction). The vision subtree dequantizes to its
+        own dtype; everything else to the text dtype."""
+        from neuronx_distributed_llama3_2_tpu.quantization import live_params
+
+        out = dict(live_params(
+            {k: v for k, v in params.items() if k != "vision_model"},
+            self.config.text.dtype,
+        ))
+        out["vision_model"] = live_params(
+            params["vision_model"], self.config.vision.dtype
+        )
+        return out
 
     # -- one-time per request ---------------------------------------------
 
@@ -95,15 +96,23 @@ class MllamaDecoder:
         self, pixel_values, aspect_ratio_ids, aspect_ratio_mask
     ) -> Tuple[jax.Array, List[jax.Array], List[jax.Array]]:
         """(vision_tokens, cross_k per layer, cross_v per layer)."""
-        t = self.config.text
-        vision_tokens = self.model.encode_images(
+        return self._precompute(
             self.params, pixel_values, aspect_ratio_ids, aspect_ratio_mask
+        )
+
+    def _precompute_cross_kv_impl(
+        self, params, pixel_values, aspect_ratio_ids, aspect_ratio_mask
+    ):
+        t = self.config.text
+        params = self._live_params(params)
+        vision_tokens = self.model.encode_images(
+            params, pixel_values, aspect_ratio_ids, aspect_ratio_mask
         )
         xattn = TextCrossAttention(t)
         ks, vs = [], []
         for i in self.config.text.cross_attention_layers:
             k, v = xattn.project_kv(
-                self.params["layers"][i]["cross_attn"], vision_tokens
+                params["layers"][i]["cross_attn"], vision_tokens
             )
             ks.append(k)
             vs.append(v)
@@ -124,6 +133,7 @@ class MllamaDecoder:
         the static precomputed K/V. Returns (logits (B, T, V), cache)."""
         t = self.config.text
         b, tlen = tokens.shape
+        params = self._live_params(params)
         x = self.model._embed()(params["embed"], tokens)
         pos_block = positions[:, None] + jnp.arange(tlen, dtype=jnp.int32)[None, :]
         sin, cos = precompute_rope(

@@ -4,6 +4,7 @@ from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
     QuantizationType,
     QuantizedTensor,
     dequantize_params,
+    live_params,
     quantization_error,
     quantize_array,
     quantize_params,
@@ -26,6 +27,7 @@ __all__ = [
     "QuantizedRowParallelLinear",
     "convert",
     "dequantize_params",
+    "live_params",
     "quantization_error",
     "quantize_array",
     "quantize_params",

@@ -195,6 +195,12 @@ DEFAULT_TARGETS = (
     r"mlp/(up|down)/kernel$",
     r"experts/gate_up$",
     r"experts/down$",
+    # Mllama naming: text cross-attention and ViT attention keep separate
+    # q/k/v/o linears, vision MLP is fc1/fc2 (models/mllama.py) — without
+    # these the vision family silently escaped weight-only quantization
+    r"(self_attn|cross_attn)/(q|k|v|o)/kernel$",
+    r"mlp/fc(1|2)/kernel$",
+    r"multi_modal_projector/kernel$",
 )
 
 
@@ -211,9 +217,18 @@ def _reduce_axes_for(path: str, ndim: int) -> Optional[Tuple[int, ...]]:
 
 
 def _walk(tree: Any, fn, path: str = "") -> Any:
-    """Recurse dict pytrees applying fn(path, leaf) at non-dict leaves."""
+    """Recurse dict/list/tuple pytrees applying fn(path, leaf) at leaves.
+    List indices become path segments (Mllama keeps its text layers as a
+    per-layer list, not a stacked array — without list recursion the whole
+    family silently escaped quantization)."""
     if isinstance(tree, dict):
         return {k: _walk(v, fn, f"{path}/{k}" if path else k) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        out = [
+            _walk(v, fn, f"{path}/{i}" if path else str(i))
+            for i, v in enumerate(tree)
+        ]
+        return type(tree)(out)
     return fn(path, tree)
 
 
@@ -279,3 +294,19 @@ def dequantize_params(params: Params, dtype=jnp.bfloat16) -> Params:
 def quantization_error(w: jax.Array, config=QuantizationConfig()) -> jax.Array:
     """Max abs reconstruction error — used by tests and calibration reports."""
     return jnp.max(jnp.abs(quantize_array(w, config).dequantize(jnp.float32) - w))
+
+
+def live_params(params: Params, dtype=jnp.bfloat16) -> Params:
+    """Per-call quantization-transparent view: dequantize QuantizedTensor
+    leaves (to ``dtype``) when any are present, identity otherwise. The
+    shared serving discipline — check the tree PASSED, not one captured at
+    construction, so a float-constructed server handed a quantized tree
+    later still dequantizes (and vice versa). Used by the text engine and
+    the Mllama decoder."""
+    has_q = any(
+        isinstance(l, QuantizedTensor)
+        for l in jax.tree.leaves(
+            params, is_leaf=lambda l: isinstance(l, QuantizedTensor)
+        )
+    )
+    return dequantize_params(params, dtype) if has_q else params
