@@ -156,18 +156,15 @@ class MoE:
                 f"token count {t} not divisible by dp*ep {dp_ep}"
             )
 
-        # XLA:CPU (the virtual test mesh) crashes compiling the gradient psum
-        # of a bf16 weight replicated over manual mesh axes ("Invalid binary
-        # instruction opcode copy"). Round-trip the expert weights through
-        # fp32 across the shard_map boundary on cpu only — the cast transpose
-        # makes the dp grad-psum fp32. Exact (bf16→f32→bf16) and TPU keeps
-        # native bf16.
-        upcast = jax.default_backend() == "cpu" and c.dtype == jnp.bfloat16
-        expert_params = params["experts"]
-        if upcast:
-            expert_params = jax.tree.map(
-                lambda a: a.astype(jnp.float32), expert_params
-            )
+        # bf16 weights crossing the manual boundary abort XLA:CPU — shared
+        # round-trip workaround (layers.shardmap_cpu_bf16_workaround)
+        from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+            shardmap_cpu_bf16_workaround,
+        )
+
+        expert_params, restore_experts = shardmap_cpu_bf16_workaround(
+            params["experts"]
+        )
 
         if c.capacity_factor is None:
             # A no-drop EP dispatch must size every expert buffer for the
@@ -185,8 +182,7 @@ class MoE:
 
         def body(router_p, expert_p, xl):
             # xl: (T_loc, H) shard-local tokens
-            if upcast:
-                expert_p = jax.tree.map(lambda a: a.astype(c.dtype), expert_p)
+            expert_p = restore_experts(expert_p)
             logits, gates, idx = self._route(router_p, xl)
             cap = experts.capacity(xl.shape[0], c.top_k)
             buf, slot, keep = experts.dispatch(xl, gates, idx, cap)

@@ -341,6 +341,39 @@ class GQAQKVColumnParallelLinear:
         return q, k, v
 
 
+def shardmap_cpu_bf16_workaround(tree: Any):
+    """Returns ``(boundary_tree, restore_fn)`` for passing ``tree`` across a
+    (partial-)manual ``shard_map`` boundary.
+
+    XLA:CPU — the virtual test mesh — aborts compiling the gradient psum of
+    bf16 leaves that cross such a boundary ("Invalid binary instruction
+    opcode copy", hlo_instruction.cc). The workaround: round-trip bf16
+    leaves through fp32 at the boundary (exact: bf16→f32→bf16) and restore
+    each leaf's original dtype inside the body with ``restore_fn``. On TPU
+    (or for bf16-free trees) both returns are identities. One shared
+    implementation for every executor that hits this (MoE EP a2a,
+    interleaved VPP) so the backend-sensitive condition lives in one place.
+    """
+    active = jax.default_backend() == "cpu" and any(
+        getattr(leaf, "dtype", None) == jnp.bfloat16
+        for leaf in jax.tree.leaves(tree)
+    )
+    if not active:
+        return tree, lambda t: t
+    dtypes = jax.tree.map(lambda leaf: leaf.dtype, tree)
+    up = jax.tree.map(
+        lambda leaf: leaf.astype(jnp.float32)
+        if leaf.dtype == jnp.bfloat16
+        else leaf,
+        tree,
+    )
+
+    def restore(t):
+        return jax.tree.map(lambda leaf, d: leaf.astype(d), t, dtypes)
+
+    return up, restore
+
+
 def shard_pytree(tree: Any, specs: Any, mesh=None) -> Any:
     """Place a parameter pytree on the mesh per its spec tree (the runtime
     counterpart of the reference's ``set_tensor_model_parallel_attributes``

@@ -331,7 +331,20 @@ class PipelinedCausalLM:
         x_mb = constrain(x_mb, P(None, BATCH_AXES, None, None))
         fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
 
+        # bf16 operands crossing the manual boundary abort XLA:CPU — the
+        # shared round-trip workaround (layers.shardmap_cpu_bf16_workaround);
+        # the replicated microbatch stream's gradient is the psum that trips
+        # the bug, so it goes through the boundary cast too
+        from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+            shardmap_cpu_bf16_workaround,
+        )
+
+        layers_in, restore_layers = shardmap_cpu_bf16_workaround(params["layers"])
+        x_mb, restore_x = shardmap_cpu_bf16_workaround(x_mb)
+
         def lane_body(layers_l, x_all):
+            layers_l = restore_layers(layers_l)
+            x_all = restore_x(x_all)
             # pp-manual leaves arrive (V, 1, Lv, ...); drop the lane dim
             layers_lane = jax.tree.map(lambda p: p[:, 0], layers_l)
             s = lax.axis_index(PP_AXIS)
@@ -407,7 +420,7 @@ class PipelinedCausalLM:
             out_specs=(P(PP_AXIS), P(PP_AXIS)),
             axis_names={PP_AXIS},
             check_vma=False,
-        )(params["layers"], x_mb)
+        )(layers_in, x_mb)
 
         hidden_mb = out_buf[pp - 1]  # (M, mbs, S, H) — exits live on lane pp-1
         hidden = hidden_mb.swapaxes(0, 1).reshape(gbs, S, -1)

@@ -670,3 +670,30 @@ def test_interleaved_via_pretrain_cli(tmp_path):
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "done: 3 steps" in r.stderr
+
+
+def test_interleaved_bf16_trains_on_cpu_mesh():
+    """bf16 interleaved executor on the CPU mesh: the replicated operands'
+    gradient psum used to abort XLA:CPU ('Invalid binary instruction opcode
+    copy'); the fp32 boundary round-trip (same workaround as
+    moe/model.py:_ep_forward) keeps it compiling. One real train step,
+    finite loss."""
+    cfg = TrainingConfig(
+        pipeline_parallel_size=2,
+        pipeline_schedule="interleaved",
+        num_model_chunks=2,
+        optimizer=OptimizerConfig(
+            zero_one_enabled=True, warmup_steps=1,
+        ),
+    )
+    cfg.initialize()
+    model_cfg = dataclasses.replace(TINY, dtype=jnp.bfloat16)
+    model = PipelinedCausalLM(
+        LlamaForCausalLM(model_cfg), num_microbatches=4,
+        schedule="interleaved", num_model_chunks=2,
+    )
+    state, _ = initialize_parallel_model(model, cfg)
+    step = make_train_step(model, cfg)
+    ids = _mk_batch(seed=13, gbs=8, seq=16)
+    state, metrics = step(state, {"input_ids": ids, "labels": ids})
+    assert np.isfinite(float(metrics["loss"]))
