@@ -133,21 +133,45 @@ METRIC_NAME = "llama3.2-1b_train_tokens_per_sec_per_chip"
 _TRANSIENT_MARKERS = ("UNAVAILABLE", "Unable to initialize", "DEADLINE_EXCEEDED")
 
 
-def _emit_failure(reason: str) -> None:
+def _probe_backend(timeout_s: float = 120.0) -> str:
+    """Independent relay probe: bare ``jax.devices()`` in a bounded subprocess.
+
+    Classifies the backend state so a driver artifact alone distinguishes a
+    relay outage from a bench regression (the round-3 outage needed prose in
+    BENCHMARKS.md to make that call). Returns one of ``"ok"``,
+    ``"backend_init_timeout"``, or ``"backend_init_error: <last line>"``.
+    """
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return "backend_init_timeout"
+    if proc.returncode == 0:
+        return "ok"
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return f"backend_init_error: {tail[-1][:200] if tail else 'unknown'}"
+
+
+def _emit_failure(reason: str, probe: str | None = None) -> None:
     """One parseable JSON line so an outage yields a failure *record*, not a
-    driver-side rc=124 with nothing to parse."""
-    print(
-        json.dumps(
-            {
-                "metric": METRIC_NAME,
-                "value": None,
-                "unit": "tokens/s",
-                "vs_baseline": None,
-                "error": reason,
-            }
-        ),
-        flush=True,
-    )
+    driver-side rc=124 with nothing to parse. ``probe`` carries the
+    independent backend-probe classification (None = probe not run)."""
+    record = {
+        "metric": METRIC_NAME,
+        "value": None,
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "error": reason,
+    }
+    if probe is not None:
+        record["probe"] = probe
+    print(json.dumps(record), flush=True)
 
 
 def _launch_once(timeout_s: float):
@@ -183,6 +207,7 @@ def main_with_retries(
     deadline_s: float | None = None,
     attempt_timeout_s: float | None = None,
     launch=_launch_once,
+    probe=_probe_backend,
 ) -> None:
     """Retry transient relay outages, bounded in wall-clock.
 
@@ -224,7 +249,10 @@ def main_with_retries(
         transient = status == "timeout" or any(m in tail for m in _TRANSIENT_MARKERS)
         if not transient:
             sys.stdout.write(out)
-            raise RuntimeError(f"bench failed (non-transient): {last_reason}")
+            # the contract is "every failure mode yields a machine-readable
+            # record" — including this one (ADVICE r3)
+            _emit_failure(f"non-transient: {last_reason}", probe=probe())
+            raise SystemExit(3)
         remaining = deadline_s - (time.monotonic() - start)
         if i < attempts - 1 and remaining > backoff_s:
             print(
@@ -234,7 +262,7 @@ def main_with_retries(
             )
             time.sleep(backoff_s)
 
-    _emit_failure(f"backend unavailable: {last_reason}")
+    _emit_failure(f"backend unavailable: {last_reason}", probe=probe())
     raise SystemExit(2)
 
 

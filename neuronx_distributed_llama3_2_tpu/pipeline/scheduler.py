@@ -365,9 +365,12 @@ class InterleavedRotationPlan:
                 else:
                     outputs.append((h % pp, h // pp, m, s))
             for dst, dv, m, src in outputs:
-                assert slots[dst][dv] == -1, (
-                    f"slot collision at lane {dst} chunk {dv}"
-                )
+                if slots[dst][dv] != -1:
+                    # explicit raise (not a bare assert) so the SPMD
+                    # executor's static routing is guarded under python -O
+                    raise AssertionError(
+                        f"slot collision at lane {dst} chunk {dv}"
+                    )
                 slots[dst][dv] = m
                 out_slot[src] = dv
             steps.append(RotationStep(chunk, mb, admit, out_slot))

@@ -83,12 +83,15 @@ class TrainingConfig:
     # (reference grad-accum loop, tp_zero1_llama_hf_pretrain.py:277-350)
     num_microbatches: int = 1
     # pipeline executor for pp > 1 (pipeline/model.py SCHEDULES); reference
-    # pipeline_config {"scheduler", "virtual_pipeline_size"} knobs
-    pipeline_schedule: str = "gpipe"
+    # pipeline_config {"scheduler", "virtual_pipeline_size"} knobs.
+    # None = follow whatever PipelinedCausalLM was constructed with; when
+    # set, the trainer validates the model matches and fails loudly on a
+    # mismatch (ADVICE r3: these knobs must never be silently ignored)
+    pipeline_schedule: "str | None" = None
     # interleaved VPP chunks per pp lane (reference TrainInterleavedSchedule
     # scheduler.py:256); >1 requires pipeline_schedule="interleaved" —
-    # measured tradeoffs in docs/interleaved_vpp.md
-    num_model_chunks: int = 1
+    # measured tradeoffs in docs/interleaved_vpp.md. None = follow the model
+    num_model_chunks: "int | None" = None
     seed: int = 42
 
     def initialize(self, devices=None) -> parallel_state.ParallelState:

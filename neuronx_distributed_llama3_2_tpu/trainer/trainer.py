@@ -46,6 +46,38 @@ def train_state_specs(model, config: TrainingConfig, params: Any) -> TrainState:
     )
 
 
+def _validate_pipeline_config(model, config: TrainingConfig) -> None:
+    """Fail loudly when TrainingConfig's pipeline knobs disagree with the
+    model actually being trained.
+
+    The schedule lives on PipelinedCausalLM, not on the trainer, so a user
+    who sets ``TrainingConfig(pipeline_schedule="interleaved")`` but wraps
+    the model with a default-constructed pipeline would otherwise silently
+    train under gpipe (ADVICE r3)."""
+    model_schedule = getattr(model, "schedule", None)
+    model_chunks = getattr(model, "num_model_chunks", None)
+    if model_schedule is None:
+        # unpipelined model: the config must not ask for a pipeline
+        if config.pipeline_schedule is not None or config.num_model_chunks is not None:
+            raise ValueError(
+                f"TrainingConfig(pipeline_schedule={config.pipeline_schedule!r},"
+                f" num_model_chunks={config.num_model_chunks}) but the model is"
+                " not pipelined — wrap it in PipelinedCausalLM(schedule=...,"
+                " num_model_chunks=...) or leave the config knobs at None"
+            )
+        return
+    if config.pipeline_schedule is not None and model_schedule != config.pipeline_schedule:
+        raise ValueError(
+            f"model schedule {model_schedule!r} != TrainingConfig."
+            f"pipeline_schedule {config.pipeline_schedule!r}"
+        )
+    if config.num_model_chunks is not None and model_chunks != config.num_model_chunks:
+        raise ValueError(
+            f"model num_model_chunks {model_chunks} != TrainingConfig."
+            f"num_model_chunks {config.num_model_chunks}"
+        )
+
+
 def initialize_parallel_model(
     model,
     config: TrainingConfig,
@@ -59,6 +91,7 @@ def initialize_parallel_model(
     (trainer/trainer.py:141-229, model_utils.py:320) to avoid host OOM; here
     XLA never builds the unsharded model anywhere.
     """
+    _validate_pipeline_config(model, config)
     if key is None:
         key = jax.random.key(config.seed)
     mesh = parallel_state.get_parallel_state().mesh
@@ -111,6 +144,7 @@ def make_train_step(
     is ONE XLA program — no per-microbatch graph breaks (the reference pays a
     mark_step per accumulation step).
     """
+    _validate_pipeline_config(model, config)
     opt_cfg = config.optimizer
     n_micro = config.num_microbatches
 

@@ -74,7 +74,9 @@ def test_hung_attempt_times_out_and_retries(capsys):
     assert calls["n"] == 2
 
 
-def test_non_transient_raises_immediately():
+def test_non_transient_fails_immediately_with_record(capsys):
+    """A non-transient failure must still produce a machine-readable JSON
+    record (ADVICE r3) carrying the probe classification."""
     bench = _load_bench()
     calls = {"n": 0}
 
@@ -82,12 +84,15 @@ def test_non_transient_raises_immediately():
         calls["n"] += 1
         return "error", "", "RuntimeError: non-finite loss nan on the bench step"
 
-    with pytest.raises(RuntimeError, match="non-transient"):
+    with pytest.raises(SystemExit):
         bench.main_with_retries(
             attempts=3, backoff_s=0.0, deadline_s=60.0, attempt_timeout_s=10.0,
-            launch=fake_launch,
+            launch=fake_launch, probe=lambda: "ok",
         )
     assert calls["n"] == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "non-transient" in rec["error"]
+    assert rec["probe"] == "ok"  # backend healthy => this is a regression
 
 
 def test_exhausted_retries_emit_parseable_failure_record(capsys):
@@ -101,13 +106,14 @@ def test_exhausted_retries_emit_parseable_failure_record(capsys):
     with pytest.raises(SystemExit):
         bench.main_with_retries(
             attempts=3, backoff_s=0.0, deadline_s=60.0, attempt_timeout_s=10.0,
-            launch=fake_launch,
+            launch=fake_launch, probe=lambda: "backend_init_timeout",
         )
     assert calls["n"] == 3
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["metric"] == bench.METRIC_NAME
     assert rec["value"] is None and rec["vs_baseline"] is None
     assert "backend unavailable" in rec["error"]
+    assert rec["probe"] == "backend_init_timeout"  # outage, not regression
 
 
 def test_deadline_caps_total_wall_clock(capsys):
@@ -127,7 +133,7 @@ def test_deadline_caps_total_wall_clock(capsys):
     with pytest.raises(SystemExit):
         bench.main_with_retries(
             attempts=100, backoff_s=0.5, deadline_s=1.0, attempt_timeout_s=0.01,
-            launch=fake_launch,
+            launch=fake_launch, probe=lambda: "backend_init_timeout",
         )
     elapsed = _time.monotonic() - t0
     assert elapsed < 10.0

@@ -245,3 +245,38 @@ def test_cast_params_downcast_keeps_norms_fp32():
     # the dequant scale must STAY fp32 (a bf16 scale would smear ~0.4%
     # relative error over every dequantized weight)
     assert q["layers"]["attn"]["qkv"]["q_kernel"].scale.dtype == jnp.float32
+
+
+def test_pipeline_config_mismatch_fails_loudly():
+    """TrainingConfig(pipeline_schedule=...) must never be silently ignored:
+    a mismatch with the model's actual schedule raises (ADVICE r3)."""
+    from neuronx_distributed_llama3_2_tpu.pipeline import PipelinedCausalLM
+    from neuronx_distributed_llama3_2_tpu.trainer import (
+        TrainingConfig,
+        make_train_step,
+    )
+
+    cfg = TrainingConfig(
+        pipeline_parallel_size=2, pipeline_schedule="interleaved",
+        num_model_chunks=2,
+    )
+    cfg.initialize()
+    try:
+        # unpipelined model + pipeline knobs set -> loud failure
+        with pytest.raises(ValueError, match="not pipelined"):
+            make_train_step(LlamaForCausalLM(TINY), cfg)
+        # pipelined model with a DIFFERENT schedule -> loud failure
+        gp = PipelinedCausalLM(LlamaForCausalLM(TINY), num_microbatches=4)
+        with pytest.raises(ValueError, match="schedule"):
+            make_train_step(gp, cfg)
+        # chunk-count mismatch -> loud failure
+        il = PipelinedCausalLM(
+            LlamaForCausalLM(TINY), num_microbatches=4,
+            schedule="interleaved", num_model_chunks=4,
+        )
+        with pytest.raises(ValueError, match="num_model_chunks"):
+            make_train_step(il, cfg)
+        # None knobs follow the model: no raise
+        make_train_step(gp, TrainingConfig(pipeline_parallel_size=2))
+    finally:
+        parallel_state.destroy_model_parallel()
