@@ -207,7 +207,7 @@ def main_with_retries(
     deadline_s: float | None = None,
     attempt_timeout_s: float | None = None,
     launch=_launch_once,
-    probe=_probe_backend,
+    probe=None,
 ) -> None:
     """Retry transient relay outages, bounded in wall-clock.
 
@@ -227,10 +227,18 @@ def main_with_retries(
     if attempt_timeout_s is None:
         attempt_timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "480"))
 
+    # the failure-path probe's wall-clock is reserved out of deadline_s so
+    # the WHOLE invocation (probe included) stays under the deadline — the
+    # driver must never see rc=124 because our own probe overran
+    probe_budget = min(120.0, 0.25 * deadline_s)
+    if probe is None:
+        probe = lambda: _probe_backend(probe_budget)  # noqa: E731
+    loop_deadline = deadline_s - probe_budget
+
     start = time.monotonic()
     last_reason = "no attempts made (deadline exhausted)"
     for i in range(attempts):
-        remaining = deadline_s - (time.monotonic() - start)
+        remaining = loop_deadline - (time.monotonic() - start)
         if remaining <= 0:
             break
         status, out, err = launch(min(attempt_timeout_s, remaining))
@@ -249,11 +257,13 @@ def main_with_retries(
         transient = status == "timeout" or any(m in tail for m in _TRANSIENT_MARKERS)
         if not transient:
             sys.stdout.write(out)
+            if out and not out.endswith("\n"):
+                sys.stdout.write("\n")  # keep the record on its own line
             # the contract is "every failure mode yields a machine-readable
             # record" — including this one (ADVICE r3)
             _emit_failure(f"non-transient: {last_reason}", probe=probe())
             raise SystemExit(3)
-        remaining = deadline_s - (time.monotonic() - start)
+        remaining = loop_deadline - (time.monotonic() - start)
         if i < attempts - 1 and remaining > backoff_s:
             print(
                 f"# backend unavailable ({last_reason}); retrying in {backoff_s:.0f}s",

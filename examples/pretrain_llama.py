@@ -183,8 +183,10 @@ def main():
     config = TrainingConfig(
         tensor_parallel_size=args.tp,
         pipeline_parallel_size=args.pp,
-        pipeline_schedule=args.pp_schedule,
-        num_model_chunks=args.model_chunks,
+        # only pin the pipeline knobs when there IS a pipeline — on pp=1
+        # the model is unpipelined and the knobs must stay None
+        pipeline_schedule=args.pp_schedule if args.pp > 1 else None,
+        num_model_chunks=args.model_chunks if args.pp > 1 else None,
         expert_parallel_size=args.ep,
         sequence_parallel=args.sp,
         # under pp the pipelined model does its own microbatching; the

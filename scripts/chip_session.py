@@ -1,0 +1,167 @@
+"""Bank one full chip session: every staged measurement in one bounded pass.
+
+The round-2 and round-3 relay outages taught two lessons (VERDICT r3 weak
+#1/#2): (a) chip time is a scarce resource — when the relay is healthy,
+every staged measurement must be captured in ONE orchestrated pass, not
+ad-hoc; (b) every stage must be bounded in wall-clock so a mid-session
+outage yields parseable failure records instead of a hung session.
+
+Each stage runs in its own subprocess with a hard timeout and appends one
+JSON record to the session artifact (``CHIP_SESSION.jsonl``)::
+
+    {"stage": ..., "rc": 0, "seconds": 12.3, "parsed": {...}, "tail": "..."}
+
+Stages (see ``STAGES``): relay probe → bench.py (the driver metric) →
+MFU sweep margin → chip-side TTFT 1B/3B → e2e latency report → serving
+churn → Pallas kernel gate → 32K long-context gate → ring-step timing.
+If the probe fails the session aborts immediately, recording the outage —
+nothing downstream can succeed without a backend.
+
+Usage::
+
+    python scripts/chip_session.py                     # full session
+    python scripts/chip_session.py --stages probe,bench
+    python scripts/chip_session.py --deadline 5400
+    python scripts/chip_session.py --list
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+PROBE_SNIPPET = (
+    "import jax, json; "
+    "print(json.dumps({'devices': [str(d) for d in jax.devices()],"
+    " 'backend': jax.default_backend()}))"
+)
+
+# (name, argv, timeout_s). Ordered by value-per-chip-minute: the driver
+# metric first, then the MFU margin, then inference/kernel/long-context.
+STAGES = [
+    ("probe", [PY, "-c", PROBE_SNIPPET], 300),
+    ("bench", [PY, os.path.join(REPO, "bench.py")], 1400),
+    ("mfu_sweep",
+     [PY, os.path.join(REPO, "scripts", "mfu_sweep.py"), "--timeout", "480"],
+     4200),
+    ("ttft_prefill_1b",
+     [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
+      "--stage", "prefill", "--model", "llama3.2-1b"], 900),
+    ("ttft_prefill_3b",
+     [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
+      "--stage", "prefill", "--model", "llama3.2-3b"], 1500),
+    ("generate_1b",
+     [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
+      "--stage", "generate", "--model", "llama3.2-1b"], 900),
+    ("churn_1b",
+     [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
+      "--stage", "churn", "--model", "llama3.2-1b"], 900),
+    ("kernel_gate",
+     [PY, os.path.join(REPO, "scripts", "tpu_kernel_gate.py")], 1200),
+    ("long_context",
+     [PY, os.path.join(REPO, "scripts", "long_context_gate.py")], 1800),
+    ("ring_step_timing",
+     [PY, os.path.join(REPO, "scripts", "ring_step_bench.py")], 1500),
+]
+
+
+def last_json_line(text: str):
+    """Parse the last line of ``text`` that looks like a JSON object."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def run_stage(name: str, argv: list, timeout_s: float) -> dict:
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s, cwd=REPO
+        )
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+        status = "ok" if rc == 0 else "error"
+    except subprocess.TimeoutExpired as e:
+        def _s(b):
+            return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
+        rc, out, err, status = None, _s(e.stdout), _s(e.stderr), "timeout"
+    except OSError as e:  # missing/unrunnable stage script — record, don't die
+        rc, out, err, status = None, "", str(e), "launch_error"
+    seconds = time.monotonic() - t0
+    return {
+        "stage": name,
+        "status": status,
+        "rc": rc,
+        "seconds": round(seconds, 1),
+        "parsed": last_json_line(out),
+        "tail": (out + ("\n--- stderr ---\n" + err if err else ""))[-1500:],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "CHIP_SESSION.jsonl"))
+    ap.add_argument("--stages", default=None,
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--deadline", type=float, default=4 * 3600.0,
+                    help="overall wall-clock budget in seconds")
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args()
+
+    if args.list:
+        for name, _, t in STAGES:
+            print(f"{name:>20}  timeout {t}s")
+        return 0
+
+    chosen = None if args.stages is None else set(args.stages.split(","))
+    stages = [s for s in STAGES if chosen is None or s[0] in chosen]
+
+    start = time.monotonic()
+    results = []
+    aborted = None
+    with open(args.out, "a") as f:
+        f.write(json.dumps({
+            "session_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "stages": [s[0] for s in stages],
+        }) + "\n")
+        f.flush()
+        for name, argv, timeout_s in stages:
+            remaining = args.deadline - (time.monotonic() - start)
+            if remaining <= 30:
+                aborted = f"deadline exhausted before stage {name}"
+                break
+            rec = run_stage(name, argv, min(timeout_s, remaining))
+            results.append(rec)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            print(f"[{rec['status']:>7}] {name} ({rec['seconds']}s)",
+                  file=sys.stderr, flush=True)
+            if name == "probe" and rec["status"] != "ok":
+                aborted = f"relay probe {rec['status']} — backend down, aborting"
+                break
+        if aborted:
+            f.write(json.dumps({"aborted": aborted}) + "\n")
+
+    ok = sum(1 for r in results if r["status"] == "ok")
+    print(json.dumps({
+        "session": "chip_session",
+        "stages_run": len(results),
+        "stages_ok": ok,
+        "aborted": aborted,
+        "out": args.out,
+    }), flush=True)
+    return 0 if (aborted is None and ok == len(results)) else 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
