@@ -14,8 +14,14 @@ the chunk's attention math.
 Causality over chunks: with contiguous partitioning, ring step r on device i
 sees the k/v chunk of device ``(i - r) mod cp``; chunks entirely in the
 future are masked (their compute is wasted — the classic contiguous-ring
-imbalance; zigzag balancing is a planned refinement), the diagonal chunk is
-causal-masked, past chunks attend fully.
+imbalance), the diagonal chunk is causal-masked, past chunks attend fully.
+
+This module holds the pure-jnp executor — the numerics oracle and the
+any-backend fallback. On TPU, :func:`ring_attention_sharded` dispatches to
+the Pallas-fused executors (``ring_attention_pallas.py``): the FA2 kernel
+per visiting chunk, a custom-VJP ring backward, and zigzag chunk
+assignment that fixes the causal imbalance (each device holds half-chunks
+``(i, 2cp-1-i)``, so every ring step does equal work everywhere).
 
 Autodiff: the ring is a ``lax.scan`` whose carry is the (acc, m, l) softmax
 state plus the rotating k/v; each step is ``jax.checkpoint``-ed, so the
@@ -29,6 +35,7 @@ RoPE'd — rope is elementwise in sequence so it stays outside, auto-sharded.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional
 
@@ -125,6 +132,48 @@ def ring_attention(
     return out.reshape(b, s_loc, n, d).astype(q.dtype)
 
 
+def resolve_cp_layout(seq: int, cp: int, causal: bool = True,
+                      force: str = "auto") -> str:
+    """Decide the cp sequence layout: ``"zigzag"`` or ``"contiguous"``.
+
+    The model permutes its hidden states ONCE (after embedding, inverse
+    before the loss) when this returns zigzag, so every attention layer
+    runs the balanced ring with no per-call layout shuffles. ``force``
+    ("auto"/"contiguous"/"zigzag") comes from the model config (tests
+    force zigzag on CPU)."""
+    if force != "auto":
+        return force
+    if causal and seq % (2 * cp) == 0 and jax.default_backend() == "tpu":
+        return "zigzag"
+    return "contiguous"
+
+
+# Trace-time layout context: the site that PERMUTES the hidden states
+# (backbone / pipeline executor) declares the layout around the layer
+# stack, and attention layers read it — one source of truth, so a
+# layout/executor mismatch is impossible by construction. Executors that
+# never permute (the 1F1B manual-VJP path) simply don't set it and their
+# attention stays contiguous. Purely static (python-level): captured at
+# jit trace time like any other structural decision.
+_CP_LAYOUT_STACK: list = []
+
+
+@contextlib.contextmanager
+def cp_layout(layout: str):
+    """Declare the cp sequence layout for attention calls traced inside."""
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"layout must be contiguous|zigzag, got {layout!r}")
+    _CP_LAYOUT_STACK.append(layout)
+    try:
+        yield
+    finally:
+        _CP_LAYOUT_STACK.pop()
+
+
+def active_cp_layout() -> str:
+    return _CP_LAYOUT_STACK[-1] if _CP_LAYOUT_STACK else "contiguous"
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -133,25 +182,99 @@ def ring_attention_sharded(
     axis_name: str,
     causal: bool = True,
     block_kv: int = DEFAULT_BLOCK_KV,
+    impl: str = "auto",
+    pre_permuted: bool = False,
 ) -> jax.Array:
     """Global-view entry point: q/k/v (B, S, N, D) with S sharded over
-    ``axis_name``; wraps :func:`ring_attention` in a partial-manual
-    shard_map. Only the cp axis goes manual — specs may not mention other
-    axes, so batch (dp/ep) and head (tp) shardings stay GSPMD-auto."""
+    ``axis_name``; wraps a ring executor in a partial-manual shard_map.
+    Only the cp axis goes manual — specs may not mention other axes, so
+    batch (dp/ep) and head (tp) shardings stay GSPMD-auto.
+
+    ``impl``: ``"jnp"`` (blockwise online-softmax ring, any backend),
+    ``"pallas"`` (Pallas FA2 kernel per visiting chunk,
+    ring_attention_pallas.py), ``"zigzag"`` (pallas + zigzag-balanced
+    chunk assignment — the causal-imbalance fix), or ``"auto"`` (zigzag
+    on TPU when the shapes allow, else jnp).
+
+    ``pre_permuted``: the inputs are ALREADY in zigzag layout (the model
+    permutes once outside the layer stack — the cheap path); without it
+    the zigzag impl applies the layout permutation around the shard_map
+    itself, paying an all-to-all-shaped shuffle per call (standalone
+    use / oracle tests only)."""
     from jax.sharding import PartitionSpec as P
 
+    cp = mesh.shape[axis_name]
+    seq = q.shape[1]
+    if impl == "auto":
+        # same eligibility rule as the model's permute site — one owner
+        if resolve_cp_layout(seq, cp, causal) == "zigzag":
+            impl = "zigzag"
+        else:
+            impl = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    if impl == "zigzag" and seq % (2 * cp):
+        # validate here too: with pre_permuted=True the zigzag_permutation
+        # check below never runs, and a bad shape would otherwise die as a
+        # cryptic _halves/concat mismatch inside the kernel
+        raise ValueError(
+            f"zigzag ring needs seq % (2*cp) == 0, got seq={seq} cp={cp}"
+        )
+
     spec = P(None, axis_name, None, None)
-    # kv_len=None: the sequence is exactly S with no padding; pass a real
-    # length here only when wiring padded-batch support
-    fn = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal, kv_len=None,
-        block_kv=block_kv,
-    )
-    return jax.shard_map(
+
+    if impl == "jnp":
+        # kv_len=None: the sequence is exactly S with no padding; pass a
+        # real length here only when wiring padded-batch support
+        fn = functools.partial(
+            ring_attention, axis_name=axis_name, causal=causal, kv_len=None,
+            block_kv=block_kv,
+        )
+    elif impl in ("pallas", "zigzag"):
+        from neuronx_distributed_llama3_2_tpu.kernels.ring_attention_pallas import (
+            ring_attention_pallas,
+        )
+
+        fn = functools.partial(
+            ring_attention_pallas, axis_name=axis_name, causal=causal,
+            zigzag=(impl == "zigzag"), block_kv=block_kv,
+        )
+    else:
+        raise ValueError(f"impl must be auto|jnp|pallas|zigzag, got {impl!r}")
+
+    perm = inv = None
+    if impl == "zigzag" and not pre_permuted:
+        from neuronx_distributed_llama3_2_tpu.kernels.ring_attention_pallas import (
+            zigzag_permutation,
+        )
+
+        # layout shuffle (an all-to-all-shaped gather): each device swaps
+        # the late half of its contiguous chunk for the mirror device's.
+        # Model code should instead permute hidden states once outside
+        # the layer stack and call with pre_permuted=True
+        perm, inv = zigzag_permutation(seq, cp)
+        q, k, v = (x.take(perm, axis=1) for x in (q, k, v))
+
+    # nested-manual support (attention inside the pp-manual pipeline
+    # executors): the inner shard_map must be built on the CURRENT abstract
+    # mesh and list the union of the already-manual axes and ours
+    shard_mesh, manual_axes = mesh, {axis_name}
+    abs_mesh = jax.sharding.get_abstract_mesh()
+    if abs_mesh is not None and abs_mesh.axis_names:
+        already_manual = {
+            n for n, t in zip(abs_mesh.axis_names, abs_mesh.axis_types)
+            if t == jax.sharding.AxisType.Manual
+        }
+        if already_manual:
+            shard_mesh = abs_mesh
+            manual_axes = already_manual | {axis_name}
+
+    out = jax.shard_map(
         lambda q, k, v: fn(q, k, v),
-        mesh=mesh,
+        mesh=shard_mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names={axis_name},
+        axis_names=manual_axes,
         check_vma=False,
     )(q, k, v)
+    if inv is not None:
+        out = out.take(inv, axis=1)
+    return out

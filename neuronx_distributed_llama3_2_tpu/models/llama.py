@@ -96,6 +96,12 @@ class LlamaConfig:
     # clamp Q/K/V projections to [-clip_qkv, clip_qkv] (DBRX attn_config,
     # reference neuron_modeling_dbrx.py:171)
     clip_qkv: Optional[float] = None
+    # cp ring sequence layout: "auto" (zigzag on TPU when divisible —
+    # balances causal work across the ring, kernels/ring_attention_pallas),
+    # "contiguous", or "zigzag" (forced; tests use it on CPU). The model
+    # permutes hidden states once outside the layer stack; attention layers
+    # must resolve the SAME value (kernels.ring_attention.resolve_cp_layout)
+    cp_ring_layout: str = "auto"
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -434,14 +440,27 @@ class LlamaAttention:
             # as a k/v ring over the cp axis (kernels/ring_attention.py) —
             # the only op in the block that mixes sequence positions
             from neuronx_distributed_llama3_2_tpu.kernels.ring_attention import (
+                active_cp_layout,
                 ring_attention_sharded,
             )
 
+            # the executor that permuted the hidden states declared the
+            # layout via cp_layout(); reading it here (instead of
+            # re-deriving) makes a layout/executor mismatch impossible.
+            # zigzag ⇒ inputs are already permuted; contiguous ⇒ pallas
+            # ring on TPU, jnp oracle elsewhere
+            layout = active_cp_layout()
+            if layout == "zigzag":
+                impl = "zigzag"
+            else:
+                impl = "pallas" if jax.default_backend() == "tpu" else "jnp"
             attn = ring_attention_sharded(
                 q, k, v,
                 parallel_state.get_parallel_state().mesh,
                 parallel_state.CP_AXIS,
                 causal=True,
+                impl=impl,
+                pre_permuted=(layout == "zigzag"),
             )
         elif c.use_flash_attention:
             from neuronx_distributed_llama3_2_tpu.kernels.flash_attention import (
@@ -635,6 +654,42 @@ class LlamaForCausalLM:
         c = self.config
         return precompute_rope(c.head_dim, s, c.rope_theta, c.rope_scaling)
 
+    def _zigzag_enter(self, x: jax.Array, positions: jax.Array):
+        """Move (B, S, ...) hidden + positions into the zigzag cp layout —
+        ONE permutation outside the layer stack (every op but attention is
+        position-wise, and attention gets the permuted positions for RoPE),
+        so the per-layer ring runs with zero layout shuffles. Returns
+        (x, positions, inv) with inv=None when the layout stays contiguous."""
+        cp = (
+            parallel_state.get_context_parallel_size()
+            if parallel_state.model_parallel_is_initialized()
+            else 1
+        )
+        if cp <= 1:
+            return x, positions, None
+        from neuronx_distributed_llama3_2_tpu.kernels.ring_attention import (
+            resolve_cp_layout,
+        )
+
+        layout = resolve_cp_layout(
+            x.shape[1], cp, causal=True,
+            force=getattr(self.config, "cp_ring_layout", "auto"),
+        )
+        if layout != "zigzag":
+            return x, positions, None
+        from neuronx_distributed_llama3_2_tpu.kernels.ring_attention_pallas import (
+            zigzag_permutation,
+        )
+
+        perm, inv = zigzag_permutation(x.shape[1], cp)
+        return x.take(perm, axis=1), positions.take(perm, axis=1), inv
+
+    @staticmethod
+    def _zigzag_exit(x: jax.Array, inv) -> jax.Array:
+        """Inverse permutation before anything order-sensitive (the loss
+        shift, logits for eval) sees the hidden states."""
+        return x if inv is None else x.take(inv, axis=1)
+
     def _backbone(self, params: Params, input_ids: jax.Array) -> jax.Array:
         """Embed + decoder stack + final norm → hidden states (B, S, H)."""
         c = self.config
@@ -642,6 +697,7 @@ class LlamaForCausalLM:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         sin, cos = self._rope(s)
         x = self._embed()(params["embed"], input_ids)
+        x, positions, zz_inv = self._zigzag_enter(x, positions)
         if self._sp_enabled():
             # enter SP region: shard seq over tp (reference
             # scatter_to_sequence_parallel_region, modeling_llama_nxd.py:578)
@@ -656,12 +712,20 @@ class LlamaForCausalLM:
         policy = _remat_policy(c.remat)
         if policy is not None:
             body = jax.checkpoint(body, policy=policy)
-        if c.scan_layers:
-            x, _ = lax.scan(body, x, params["layers"])
-        else:
-            for i in range(c.num_layers):
-                x, _ = body(x, jax.tree.map(lambda p: p[i], params["layers"]))
+        from neuronx_distributed_llama3_2_tpu.kernels.ring_attention import (
+            cp_layout,
+        )
+
+        with cp_layout("zigzag" if zz_inv is not None else "contiguous"):
+            if c.scan_layers:
+                x, _ = lax.scan(body, x, params["layers"])
+            else:
+                for i in range(c.num_layers):
+                    x, _ = body(
+                        x, jax.tree.map(lambda p: p[i], params["layers"])
+                    )
         x = self._norm()(params["final_norm"], x)
+        x = self._zigzag_exit(x, zz_inv)
         if self._sp_enabled():
             # exit SP region (reference gather_from_sequence_parallel_region,
             # modeling_llama_nxd.py:625)

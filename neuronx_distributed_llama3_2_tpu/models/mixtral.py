@@ -153,6 +153,13 @@ class MixtralForCausalLM:
     def _rope(self, s: int):
         return self._llama()._rope(s)
 
+    def _zigzag_enter(self, x, positions):
+        # cp zigzag layout (kernels/ring_attention.py): shared machinery,
+        # needed here because the pipeline executor calls it on any model
+        return self._llama()._zigzag_enter(x, positions)
+
+    _zigzag_exit = staticmethod(LlamaForCausalLM._zigzag_exit)
+
     def init(self, key: jax.Array) -> Params:
         c = self.config
         ke, kl, kh = jax.random.split(key, 3)
@@ -192,6 +199,7 @@ class MixtralForCausalLM:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         sin, cos = self._rope(s)
         x = self._llama()._embed()(params["embed"], input_ids)
+        x, positions, zz_inv = self._zigzag_enter(x, positions)
         if parallel_state.sequence_parallel_enabled():
             x = constrain(x, P(BATCH_AXES, TP_AXIS, None))
 
@@ -204,16 +212,24 @@ class MixtralForCausalLM:
         policy = _remat_policy(c.remat)
         if policy is not None:
             body = jax.checkpoint(body, policy=policy)
-        if c.scan_layers:
-            x, aux = lax.scan(body, x, params["layers"])
-            aux = jnp.mean(aux)
-        else:
-            auxes = []
-            for i in range(c.num_layers):
-                x, a = body(x, jax.tree.map(lambda p: p[i], params["layers"]))
-                auxes.append(a)
-            aux = jnp.mean(jnp.stack(auxes))
+        from neuronx_distributed_llama3_2_tpu.kernels.ring_attention import (
+            cp_layout,
+        )
+
+        with cp_layout("zigzag" if zz_inv is not None else "contiguous"):
+            if c.scan_layers:
+                x, aux = lax.scan(body, x, params["layers"])
+                aux = jnp.mean(aux)
+            else:
+                auxes = []
+                for i in range(c.num_layers):
+                    x, a = body(
+                        x, jax.tree.map(lambda p: p[i], params["layers"])
+                    )
+                    auxes.append(a)
+                aux = jnp.mean(jnp.stack(auxes))
         x = self._llama()._norm()(params["final_norm"], x)
+        x = self._zigzag_exit(x, zz_inv)
         if parallel_state.sequence_parallel_enabled():
             x = constrain(x, P(BATCH_AXES, None, None))
         return x, aux

@@ -241,6 +241,9 @@ class PipelinedCausalLM:
         sin, cos = self.model._rope(S)
 
         x = self.model._embed()(params["embed"], input_ids)  # (GBS, S, H)
+        # cp zigzag layout: permute once here (position-wise stages keep the
+        # layout; attention resolves the same cp layout), inverse at the loss
+        x, positions, zz_inv = self.model._zigzag_enter(x, positions)
         # strided microbatch split (see trainer.make_train_step): microbatch
         # m = rows m::M, keeping every dp shard present in every microbatch
         x_mb = x.reshape(mbs, M, S, -1).swapaxes(0, 1)  # (M, mbs, S, H)
@@ -283,12 +286,19 @@ class PipelinedCausalLM:
             )
             return (stream, out_buf, aux_sum), None
 
-        (stream, out_buf, aux_sum), _ = lax.scan(
-            rotate, (stream, out_buf, jnp.float32(0.0)), jnp.arange(M + pp - 1)
+        from neuronx_distributed_llama3_2_tpu.kernels.ring_attention import (
+            cp_layout,
         )
+
+        with cp_layout("zigzag" if zz_inv is not None else "contiguous"):
+            (stream, out_buf, aux_sum), _ = lax.scan(
+                rotate, (stream, out_buf, jnp.float32(0.0)),
+                jnp.arange(M + pp - 1),
+            )
         # undo the strided microbatch split
         hidden = out_buf.swapaxes(0, 1).reshape(gbs, S, -1)
         hidden = self.model._norm()(params["final_norm"], hidden)
+        hidden = self.model._zigzag_exit(hidden, zz_inv)
         # every (stage, microbatch) pair contributed its stage-mean aux once
         return hidden, aux_sum / (pp * M)
 

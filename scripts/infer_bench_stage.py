@@ -36,7 +36,10 @@ def main() -> None:
     ap.add_argument("--stage", required=True,
                     choices=("prefill", "generate", "churn"))
     ap.add_argument("--model", default="llama3.2-1b")
-    ap.add_argument("--batch", type=int, default=1)
+    # churn needs >= 2 slots or the staggered-admission regime it gates on
+    # (multi-slot admissions/completions mid-run) degenerates to sequential
+    ap.add_argument("--batch", type=int, default=None,
+                    help="default: 1 (prefill/generate), 4 (churn)")
     ap.add_argument("--max-seq-len", type=int, default=1024)
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--max-new-tokens", type=int, default=64)
@@ -54,6 +57,9 @@ def main() -> None:
     from neuronx_distributed_llama3_2_tpu.inference import InferenceEngine
     from neuronx_distributed_llama3_2_tpu.inference import runner as bench_runner
     from neuronx_distributed_llama3_2_tpu.models import resolve_model
+
+    if args.batch is None:
+        args.batch = 4 if args.stage == "churn" else 1
 
     entry = resolve_model(args.model)
     config = entry["config"]

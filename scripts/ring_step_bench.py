@@ -1,0 +1,145 @@
+"""Single-chip timing of one ring-attention step: Pallas vs jnp.
+
+VERDICT r3 missing #3 "done" criterion: a measurement showing what the
+Pallas-fused ring step buys over the jnp blockwise path at long-context
+chunk sizes. One ring step on one device = local queries (S/cp tokens)
+attending one visiting k/v chunk — exactly the unit the ring executors
+(kernels/ring_attention*.py) pay cp times per layer. This script times
+that unit fwd and fwd+bwd for both implementations at Llama-3.2-1B head
+geometry, S ∈ {8K, 32K}, cp = 4, and prints ONE JSON line.
+
+The multi-device rotation itself (ppermute) is not measurable on one
+chip; the dryrun meshes validate it for correctness and the compute term
+timed here dominates the wall-clock of each lock-step round.
+
+Usage::
+
+    python scripts/ring_step_bench.py                # real chip
+    python scripts/ring_step_bench.py --quick --cpu  # plumbing test
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def time_fn(fn, *args, repeats=8):
+    """Wall time per call with the host round-trip amortized out.
+
+    ``repeats`` calls are chained on-device inside one jitted lax.scan
+    (each iteration's output feeds a data dependency into the next so XLA
+    cannot elide the chain), then ONE host sync — the same pattern as
+    inference.runner.benchmark_prefill_on_device. A per-iteration
+    device_get would add the ~90 ms dev-chip tunnel RTT to every sample
+    and drown the few-ms kernel difference being measured."""
+    import jax.numpy as jnp
+
+    def chained(*a):
+        def body(carry, _):
+            out = fn(carry, *a[1:])
+            # fold a negligible-but-unknown scalar of the output back into
+            # the q carry: a real data dependency XLA cannot constant-fold
+            # away (a literal *0 nudge would be folded and the chain CSE'd)
+            first = jax.tree.leaves(out)[0]
+            nudge = first.reshape(-1)[0].astype(a[0].dtype) * jnp.asarray(
+                1e-12, a[0].dtype
+            )
+            return carry + nudge, None
+
+        carry, _ = jax.lax.scan(body, a[0], None, length=repeats)
+        return carry
+
+    g = jax.jit(chained)
+    _sync(g(*args))  # compile + warmup
+    t0 = time.perf_counter()
+    _sync(g(*args))
+    return (time.perf_counter() - t0) / repeats
+
+
+def _sync(tree):
+    import numpy as np
+
+    leaf = jax.tree.leaves(tree)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true", help="CPU backend (plumbing)")
+    ap.add_argument("--quick", action="store_true", help="tiny shapes")
+    ap.add_argument("--cp", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    global jax
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.kernels.flash_attention import (
+        blockwise_attention_stats,
+    )
+    from neuronx_distributed_llama3_2_tpu.kernels.pallas_flash_attention import (
+        pallas_flash_attention,
+    )
+
+    B, N, NKV, D = 1, 32, 8, 64  # llama3.2-1b geometry
+    seqs = (512,) if args.quick else (8192, 32768)
+    cp = args.cp
+    rows = []
+    for S in seqs:
+        s_loc = S // cp
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((B, s_loc, N, D)) * 0.1, jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((B, s_loc, NKV, D)) * 0.1, jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((B, s_loc, NKV, D)) * 0.1, jnp.bfloat16)
+
+        # one non-causal ring step: local q × one visiting (past) chunk
+        def jnp_fwd(q, k, v):
+            return blockwise_attention_stats(q, k, v, causal=False)[0]
+
+        def pallas_fwd(q, k, v):
+            return pallas_flash_attention(q, k, v, causal=False)
+
+        def mk_loss(fn):
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        entry = {"seq": S, "chunk": s_loc, "cp": cp}
+        for name, fwd in (("jnp", jnp_fwd), ("pallas", pallas_fwd)):
+            f = jax.jit(fwd)
+            g = mk_loss(fwd)
+            entry[f"{name}_fwd_ms"] = round(
+                time_fn(f, q, k, v, repeats=args.iters) * 1e3, 3
+            )
+            entry[f"{name}_fwdbwd_ms"] = round(
+                time_fn(g, q, k, v, repeats=args.iters) * 1e3, 3
+            )
+        entry["fwd_speedup"] = round(
+            entry["jnp_fwd_ms"] / max(entry["pallas_fwd_ms"], 1e-9), 2
+        )
+        entry["fwdbwd_speedup"] = round(
+            entry["jnp_fwdbwd_ms"] / max(entry["pallas_fwdbwd_ms"], 1e-9), 2
+        )
+        rows.append(entry)
+
+    print(json.dumps({
+        "bench": "ring_step_pallas_vs_jnp",
+        "chip": str(jax.devices()[0]),
+        "geometry": {"batch": B, "heads": N, "kv_heads": NKV, "head_dim": D},
+        "rows": rows,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
