@@ -174,6 +174,12 @@ def active_cp_layout() -> str:
     return _CP_LAYOUT_STACK[-1] if _CP_LAYOUT_STACK else "contiguous"
 
 
+def cp_layout_from_inv(zz_inv):
+    """The executor-side declare ceremony in one place: pass the inverse
+    permutation returned by ``_zigzag_enter`` (None ⇒ contiguous)."""
+    return cp_layout("zigzag" if zz_inv is not None else "contiguous")
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,

@@ -213,10 +213,10 @@ class MixtralForCausalLM:
         if policy is not None:
             body = jax.checkpoint(body, policy=policy)
         from neuronx_distributed_llama3_2_tpu.kernels.ring_attention import (
-            cp_layout,
+            cp_layout_from_inv,
         )
 
-        with cp_layout("zigzag" if zz_inv is not None else "contiguous"):
+        with cp_layout_from_inv(zz_inv):
             if c.scan_layers:
                 x, aux = lax.scan(body, x, params["layers"])
                 aux = jnp.mean(aux)

@@ -287,10 +287,10 @@ class PipelinedCausalLM:
             return (stream, out_buf, aux_sum), None
 
         from neuronx_distributed_llama3_2_tpu.kernels.ring_attention import (
-            cp_layout,
+            cp_layout_from_inv,
         )
 
-        with cp_layout("zigzag" if zz_inv is not None else "contiguous"):
+        with cp_layout_from_inv(zz_inv):
             (stream, out_buf, aux_sum), _ = lax.scan(
                 rotate, (stream, out_buf, jnp.float32(0.0)),
                 jnp.arange(M + pp - 1),
