@@ -174,6 +174,16 @@ class CheckpointIOState:
         self._tag = str(tag)
         self._work = []
         self._nonce = None
+        if _is_writer():
+            self.storage.makedirs(self._tag)
+            # overwriting a completed tag: drop its done marker first so a
+            # torn overwrite reads as incomplete, not as a valid mixed
+            # state. This happens BEFORE the nonce collective below, which
+            # doubles as a barrier: no other process can leave begin() (and
+            # start writing chunk bytes) until process 0 has joined the
+            # broadcast — i.e. until the old `done` marker is gone.
+            self.storage.unmark_done(self._tag)
+            self.storage.mark_checkpoint(self._tag)
         if jax.process_count() > 1:
             # agree a fresh save generation across processes (main thread —
             # collectives must never run on the async writer thread). The
@@ -190,15 +200,9 @@ class CheckpointIOState:
             seed = np.frombuffer(uuid.uuid4().bytes[:8], dtype=np.int64)[0]
             agreed = broadcast_from_host0(np.asarray([seed]))
             self._nonce = f"{int(np.asarray(agreed)[0]) & 0xFFFFFFFFFFFF:012x}"
-        if _is_writer():
-            self.storage.makedirs(self._tag)
-            # overwriting a completed tag: drop its done marker first so a
-            # torn overwrite reads as incomplete, not as a valid mixed state
-            self.storage.unmark_done(self._tag)
-            self.storage.mark_checkpoint(self._tag)
-        elif jax.process_count() > 1:
-            # sharded writers need the tag dir too (idempotent; shared fs)
-            self.storage.makedirs(self._tag)
+            if not _is_writer():
+                # sharded writers need the tag dir too (idempotent)
+                self.storage.makedirs(self._tag)
 
     def add_tree(self, kind: str, tree: Any) -> None:
         import jax
@@ -519,7 +523,7 @@ def _load_tree(
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def _load_chunk(storage: BaseCheckpointStorage, tag: str, chunk, dtype_name,
+def _load_chunk(storage: BaseCheckpointStorage, tag: str, chunk,
                 cache: Dict[str, np.ndarray]) -> np.ndarray:
     arr = cache.get(chunk["file"])
     if arr is None:
@@ -550,7 +554,7 @@ def _read_region(
         ]
         if any(a >= b for a, b in inter):
             continue
-        arr = _load_chunk(storage, tag, chunk, entry["dtype"], cache)
+        arr = _load_chunk(storage, tag, chunk, cache)
         src = tuple(
             slice(a - ca, b - ca) for (a, b), (ca, _) in zip(inter, cidx)
         )

@@ -341,6 +341,19 @@ class GQAQKVColumnParallelLinear:
         return q, k, v
 
 
+def psum_cpu_bf16_safe(v, axis_name: str):
+    """``lax.psum`` that round-trips bf16 through fp32 on XLA:CPU — the
+    same "Invalid binary instruction opcode copy" abort class as
+    :func:`shardmap_cpu_bf16_workaround` (boundary leaves), applied to
+    in-region psums. The backend-sensitive predicate lives HERE only."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if jax.default_backend() == "cpu" and v.dtype == jnp.bfloat16:
+        return lax.psum(v.astype(jnp.float32), axis_name).astype(v.dtype)
+    return lax.psum(v, axis_name)
+
+
 def shardmap_cpu_bf16_workaround(tree: Any):
     """Returns ``(boundary_tree, restore_fn)`` for passing ``tree`` across a
     (partial-)manual ``shard_map`` boundary.

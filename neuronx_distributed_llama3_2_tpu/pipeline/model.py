@@ -37,6 +37,7 @@ to amortize (same guidance as the reference's 1F1B).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Tuple
 
 import jax
@@ -55,6 +56,38 @@ from neuronx_distributed_llama3_2_tpu.parallel.state import PP_AXIS, TP_AXIS
 Params = Dict[str, Any]
 
 SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _seq_slice(x, start, chunk: int):
+    """dynamic_slice along seq whose VJP avoids the data-dependent scatter
+    that aborts the XLA partitioner inside a partial-manual (pp-manual,
+    tp-auto) region (spmd_partitioner_util CHECK — same class as
+    docs/moe_1f1b_tp.md): the backward rebuilds the padded cotangent with
+    pad+roll, which lowers to gathers only."""
+    return lax.dynamic_slice_in_dim(x, start, chunk, axis=1)
+
+
+def _seq_slice_fwd(x, start, chunk: int):
+    return _seq_slice(x, start, chunk), (x.shape[1], start)
+
+
+def _seq_slice_bwd(chunk: int, res, dy):
+    full, start = res
+    dx = jnp.pad(dy, ((0, 0), (0, full - chunk), (0, 0)))
+    return jnp.roll(dx, start, axis=1), None
+
+
+_seq_slice.defvjp(_seq_slice_fwd, _seq_slice_bwd)
+
+
+def _psum_pp(v):
+    """psum over the pp axis, CPU-bf16-safe (parallel.layers helper)."""
+    from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+        psum_cpu_bf16_safe,
+    )
+
+    return psum_cpu_bf16_safe(v, PP_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +113,14 @@ class PipelinedCausalLM:
     # following scheduler.InterleavedRotationPlan — measured tradeoffs in
     # docs/interleaved_vpp.md.
     num_model_chunks: int = 1
+    # 1F1B only: split the LM-head/CE computation across pp lanes by
+    # sequence slice instead of running the FULL head on every lane with
+    # (pp-1)/pp of it masked to garbage. Under SPMD the masked head sits on
+    # every rotation's critical path (the last lane must finish it before
+    # the next exchange), so splitting divides the per-rotation head cost
+    # by pp at the price of two (mbs, S, H) psums. At Llama-3 vocab (128K)
+    # the head is a large rotation fraction — docs/head_waste.md quantifies.
+    head_sequence_split: bool = True
 
     def __post_init__(self):
         if not (isinstance(self.model, LlamaForCausalLM) or self._is_moe()):
@@ -490,6 +531,40 @@ class PipelinedCausalLM:
         )
         return loss_sum
 
+    def _head_loss_sum_slice(
+        self, head_params: Params, h: jax.Array, labels_m, lane, pp: int
+    ):
+        """This lane's 1/pp sequence slice of the un-normalized CE sum.
+
+        Summed over lanes (psum) this equals :meth:`_head_loss_sum` exactly:
+        the shifted sequence is padded to pp equal chunks with ignore-index
+        labels, which the CE's validity mask zeroes. The per-lane head cost
+        drops to head/pp — the 1F1B head-waste mitigation (docs/
+        head_waste.md)."""
+        cfg = self.config
+        h = self.model._norm()(head_params["final_norm"], h)
+        hs = h[:, :-1, :]
+        lab = labels_m[:, 1:]
+        sm1 = hs.shape[1]
+        chunk = -(-sm1 // pp)  # ceil
+        pad = pp * chunk - sm1
+        if pad:
+            hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+            lab = jnp.pad(lab, ((0, 0), (0, pad)), constant_values=-100)
+        hs = _seq_slice(hs, lane * chunk, chunk)
+        lab = lax.dynamic_slice_in_dim(lab, lane * chunk, chunk, axis=1)
+        from neuronx_distributed_llama3_2_tpu.parallel.loss import (
+            fused_linear_cross_entropy,
+        )
+
+        loss_sum, _ = fused_linear_cross_entropy(
+            hs,
+            lambda hc: self.model._logits(head_params, hc),
+            lab,
+            chunk_size=min(cfg.loss_chunk_size or chunk, chunk),
+        )
+        return loss_sum
+
     def loss_and_grad(
         self, params: Params, input_ids: jax.Array, labels: jax.Array
     ) -> Tuple[jax.Array, Params]:
@@ -509,9 +584,11 @@ class PipelinedCausalLM:
         final-norm/LM-head/CE on lane pp-1 (fixing the advisor's
         "embed/head replicated across stages" note); with tied embeddings
         both lanes contribute to the embedding grad and the lane-grads are
-        psum-merged over pp. Under SPMD every lane executes the same head
-        program on its own (mostly discarded) data — wasted flops worth
-        head/(head+stage) per rotation; pick gpipe when memory allows.
+        psum-merged over pp. With ``head_sequence_split`` (default) the
+        head/CE is sequence-split across lanes — per-rotation head cost
+        head/pp plus two (mbs, S, H) psums instead of a full masked head
+        on every lane (was head/(head+stage) of each rotation's critical
+        path — 34% for 8B at pp=8; quantified in docs/head_waste.md).
         """
         if self.schedule == "interleaved":
             # the (V, pp, Lv, ...) chunk layout is not the 1F1B stream
@@ -559,6 +636,8 @@ class PipelinedCausalLM:
             if moe
             else jnp.float32(0.0)
         )
+
+        split_head = self.head_sequence_split and pp > 1
 
         def stage_fwd(stage_layers, x):
             return self._scan_stage(stage_layers, x, sin, cos, positions)
@@ -620,18 +699,51 @@ class PipelinedCausalLM:
                     fwd_valid, aux_m.astype(jnp.float32), 0.0
                 )
 
-                # ---- head (value used on the last lane only) ----
-                def head_fn(hp, h):
-                    return self._head_loss_sum(hp, h, lab_f)
+                # ---- head ----
+                if split_head:
+                    # sequence-split: every lane computes the CE for a 1/pp
+                    # token slice of the LAST lane's current microbatch —
+                    # the full-head-on-every-lane waste becomes useful
+                    # parallelism (per-rotation head cost: head/pp + two
+                    # (mbs, S, H) psums). docs/head_waste.md quantifies.
+                    m_last = t - (pp - 1)
+                    last_valid = (m_last >= 0) & (m_last < M)
+                    lab_last = lax.dynamic_index_in_dim(
+                        lab_all, jnp.clip(m_last, 0, M - 1), axis=0,
+                        keepdims=False,
+                    )
+                    y_bcast = _psum_pp(
+                        jnp.where(is_last, y, jnp.zeros_like(y))
+                    )
 
-                loss_m, head_vjp = jax.vjp(head_fn, head_p, y)
-                dhead, dh = head_vjp(
-                    jnp.float32(1.0) / total_count
-                )
-                head_active = is_last & fwd_valid
-                loss_sum = carry["loss_sum"] + jnp.where(
-                    head_active, loss_m, 0.0
-                )
+                    def head_fn(hp, h):
+                        return self._head_loss_sum_slice(
+                            hp, h, lab_last, s, pp
+                        )
+
+                    loss_m, head_vjp = jax.vjp(head_fn, head_p, y_bcast)
+                    dhead, dh_slice = head_vjp(
+                        jnp.float32(1.0) / total_count
+                    )
+                    # each lane produced the dh rows of its slice; the sum
+                    # is the full cotangent (the VJP of the broadcast psum)
+                    dh = _psum_pp(dh_slice)
+                    head_active = last_valid
+                    loss_sum = carry["loss_sum"] + jnp.where(
+                        last_valid, loss_m, 0.0
+                    )
+                else:
+                    def head_fn(hp, h):
+                        return self._head_loss_sum(hp, h, lab_f)
+
+                    loss_m, head_vjp = jax.vjp(head_fn, head_p, y)
+                    dhead, dh = head_vjp(
+                        jnp.float32(1.0) / total_count
+                    )
+                    head_active = is_last & fwd_valid
+                    loss_sum = carry["loss_sum"] + jnp.where(
+                        head_active, loss_m, 0.0
+                    )
 
                 # ---- backward ----
                 # last lane's bwd cotangent is its own head grad from this

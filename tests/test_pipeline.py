@@ -697,3 +697,36 @@ def test_interleaved_bf16_trains_on_cpu_mesh():
     ids = _mk_batch(seed=13, gbs=8, seq=16)
     state, metrics = step(state, {"input_ids": ids, "labels": ids})
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_1f1b_head_split_matches_unsplit():
+    """head_sequence_split: the sequence-split head (per-lane 1/pp slice of
+    the last lane's microbatch, psum-merged) must reproduce the replicated
+    head bit-for-bit-ish — loss, grad_norm, and a post-step head weight.
+    docs/head_waste.md has the flops quantification."""
+    results = {}
+    for split in (False, True):
+        parallel_state.destroy_model_parallel()
+        cfg = TrainingConfig(
+            pipeline_parallel_size=4,
+            optimizer=OptimizerConfig(zero_one_enabled=True, warmup_steps=1),
+        )
+        cfg.initialize()
+        model_cfg = dataclasses.replace(TINY, num_kv_heads=4)
+        model = PipelinedCausalLM(
+            LlamaForCausalLM(model_cfg), num_microbatches=8,
+            schedule="1f1b", head_sequence_split=split,
+        )
+        state, _ = initialize_parallel_model(model, cfg)
+        step = make_train_step(model, cfg)
+        ids = _mk_batch(seed=21, gbs=8, seq=33)  # odd seq: slice padding path
+        state, m = step(state, {"input_ids": ids, "labels": ids})
+        embed = np.asarray(
+            jax.device_get(state.params["embed"]["embedding"]), np.float32
+        )
+        results[split] = (float(m["loss"]), float(m["grad_norm"]), embed)
+    (l0, g0, w0), (l1, g1, w1) = results[False], results[True]
+    assert abs(l1 - l0) / abs(l0) < 1e-5, (l0, l1)
+    assert abs(g1 - g0) / abs(g0) < 1e-4, (g0, g1)
+    np.testing.assert_allclose(w1, w0, rtol=2e-3, atol=2e-5)
+    parallel_state.destroy_model_parallel()
