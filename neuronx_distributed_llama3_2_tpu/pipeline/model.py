@@ -113,6 +113,11 @@ class PipelinedCausalLM:
     # following scheduler.InterleavedRotationPlan — measured tradeoffs in
     # docs/interleaved_vpp.md.
     num_model_chunks: int = 1
+    # interleaved only: True (default) runs the 1F1B-grade memory-bounded
+    # backward (Interleaved1F1BPlan: manual-VJP per virtual stage, stash
+    # ring O(pp·V)); False restores the autodiff backward (gpipe memory
+    # profile, O(M) stashed rotation streams) — docs/interleaved_vpp.md
+    memory_bounded_backward: bool = True
     # 1F1B only: split the LM-head/CE computation across pp lanes by
     # sequence slice instead of running the FULL head on every lane with
     # (pp-1)/pp of it masked to garbage. Under SPMD the masked head sits on
@@ -147,6 +152,15 @@ class PipelinedCausalLM:
         )
 
         return isinstance(self.model, MixtralForCausalLM)
+
+    @property
+    def uses_manual_vjp(self) -> bool:
+        """True when training must go through :meth:`loss_and_grad` (the
+        fused manual-VJP executors) instead of autodiff on :meth:`loss` —
+        the trainer dispatches on this."""
+        return self.schedule == "1f1b" or (
+            self.schedule == "interleaved" and self.memory_bounded_backward
+        )
 
     @property
     def config(self):
@@ -591,12 +605,7 @@ class PipelinedCausalLM:
         path — 34% for 8B at pp=8; quantified in docs/head_waste.md).
         """
         if self.schedule == "interleaved":
-            # the (V, pp, Lv, ...) chunk layout is not the 1F1B stream
-            # layout; interleaved backward runs via autodiff on loss()
-            raise ValueError(
-                "loss_and_grad is the 1F1B executor; schedule='interleaved' "
-                "differentiates loss() (autodiff backward)"
-            )
+            return self._interleaved_loss_and_grad(params, input_ids, labels)
         cfg = self.config
         pp, M = self._pp(), self.num_microbatches
         gbs, S = input_ids.shape
@@ -847,6 +856,335 @@ class PipelinedCausalLM:
         # dp-sharded optimizer update trips XLA's SPMD partitioner otherwise
         grads = jax.tree.map(
             lambda g, s: constrain(g, s),
+            grads,
+            self.specs(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return loss, grads
+
+    def _interleaved_loss_and_grad(
+        self, params: Params, input_ids: jax.Array, labels: jax.Array
+    ) -> Tuple[jax.Array, Params]:
+        """Interleaved VPP with a 1F1B-grade memory-bounded backward.
+
+        Executes the host-simulated :class:`..pipeline.scheduler
+        .Interleaved1F1BPlan` (reference ``TrainInterleavedSchedule``
+        scheduler.py:256,319-353 interleaves fwd AND bwd per model chunk):
+        each rotation every lane runs at most one virtual-stage forward and
+        one manual-VJP backward. Saved stage inputs live in a stash ring of
+        ``plan.stash_depth`` entries (≈ 2·pp·V) — O(pp·V), bounded in M,
+        unlike the autodiff interleaved backward that stashes every
+        rotation's stream (O(M); ``memory_bounded_backward=False``
+        restores it). Chunk-indexed state uses one-hot masked
+        reads/updates: a scatter-add at a lane-dependent index aborts the
+        partial-manual partitioner (docs/moe_1f1b_tp.md class); the stash
+        ring's write index t % D is lane-independent so the plain
+        dynamic-update pattern of the V=1 executor stays safe.
+        """
+        cfg = self.config
+        pp, M, V = self._pp(), self.num_microbatches, self.num_model_chunks
+        gbs, S = input_ids.shape
+        if gbs % M != 0:
+            raise ValueError(f"batch {gbs} not divisible by microbatches {M}")
+        mbs = gbs // M
+        H = cfg.hidden_size
+        mesh = parallel_state.get_parallel_state().mesh
+
+        from neuronx_distributed_llama3_2_tpu.pipeline.scheduler import (
+            Interleaved1F1BPlan,
+        )
+
+        plan = Interleaved1F1BPlan(M, V, pp)
+        D = plan.stash_depth
+        T = plan.num_rotations
+        split_head = self.head_sequence_split and pp > 1
+
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mbs, S))
+        sin, cos = self.model._rope(S)
+        ids_mb = input_ids.reshape(mbs, M, S).swapaxes(0, 1)
+        lab_mb = labels.reshape(mbs, M, S).swapaxes(0, 1)
+
+        from neuronx_distributed_llama3_2_tpu.parallel.loss import valid_token_mask
+
+        total_count = jnp.maximum(
+            valid_token_mask(labels[:, 1:], cfg.vocab_size)
+            .astype(jnp.float32)
+            .sum(),
+            1.0,
+        )
+
+        embed = self.model._embed()
+        head_params = self._head_params(params)
+        moe = self._is_moe()
+        aux_ct = (
+            jnp.float32(cfg.router_aux_loss_coef / (pp * V * M))
+            if moe
+            else jnp.float32(0.0)
+        )
+
+        # static plan → (T, pp) gather tables
+        def tbl(attr):
+            return jnp.asarray(
+                [getattr(st, attr) for st in plan.steps_], jnp.int32
+            )
+
+        tables = {
+            k: tbl(k)
+            for k in (
+                "f_chunk", "f_mb", "f_admit", "f_final", "b_chunk", "b_mb",
+                "b_first", "b_read_slot", "recv_f_chunk", "recv_b_chunk",
+            )
+        }
+        tables["head_mb"] = jnp.asarray(
+            [st.head_mb for st in plan.steps_], jnp.int32
+        )
+        tables["t"] = jnp.arange(T, dtype=jnp.int32)
+
+        def stage_fwd(chunk_layers, x):
+            return self._scan_stage(chunk_layers, x, sin, cos, positions)
+
+        def lane_body(stage_layers, head_p, embed_p, ids_all, lab_all):
+            # (V, 1, Lv, ...) per lane → (V, Lv, ...)
+            stage_layers = jax.tree.map(lambda p: p[:, 0], stage_layers)
+            s = lax.axis_index(PP_AXIS)
+            fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+            bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+            is_last = s == pp - 1
+
+            def oh_stream(idx):
+                """(V, 1, 1, 1) one-hot over chunk wait slots; idx<0 ⇒ 0."""
+                return (
+                    (jnp.arange(V) == idx).astype(jnp.float32)
+                )[:, None, None, None]
+
+            zeros_g = {
+                "layers": jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), stage_layers
+                ),
+                "head": jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), head_p
+                ),
+                "embed": jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), embed_p
+                ),
+            }
+            carry0 = {
+                "inbox_f": jnp.zeros((mbs, S, H), cfg.dtype),
+                "inbox_b": jnp.zeros((mbs, S, H), cfg.dtype),
+                "fwait": jnp.zeros((V, mbs, S, H), cfg.dtype),
+                "bwait": jnp.zeros((V, mbs, S, H), cfg.dtype),
+                "stash": jnp.zeros((D, mbs, S, H), cfg.dtype),
+                "grads": zeros_g,
+                "loss_sum": jnp.float32(0.0),
+                "aux_sum": jnp.float32(0.0),
+            }
+
+            def rotation(carry, xs):
+                fc = xs["f_chunk"][s]
+                fm = xs["f_mb"][s]
+                fad = xs["f_admit"][s]
+                ffin = xs["f_final"][s]
+                bc = xs["b_chunk"][s]
+                bm = xs["b_mb"][s]
+                bfir = xs["b_first"][s]
+                bslot = xs["b_read_slot"][s]
+                rfc = xs["recv_f_chunk"][s]
+                rbc = xs["recv_b_chunk"][s]
+                head_m = xs["head_mb"]
+                t = xs["t"]
+
+                # ---- land last rotation's streams in their wait slots ----
+                mf = oh_stream(rfc).astype(cfg.dtype)
+                fwait = carry["fwait"] * (1 - mf) + carry["inbox_f"][None] * mf
+                mb_in = oh_stream(rbc).astype(cfg.dtype)
+                bwait = carry["bwait"] * (1 - mb_in) + carry["inbox_b"][None] * mb_in
+
+                # ---- forward: consume wait slot / fresh admission --------
+                fwd_valid = fc >= 0
+                ids_f = lax.dynamic_index_in_dim(
+                    ids_all, jnp.clip(fm, 0, M - 1), axis=0, keepdims=False
+                )
+                x_embed = embed(embed_p, ids_f).astype(cfg.dtype)
+                sel_f = oh_stream(fc).astype(cfg.dtype)
+                x_wait = jnp.sum(sel_f * fwait, axis=0)
+                x_in = jnp.where(fad > 0, x_embed, x_wait)
+                consume_f = oh_stream(
+                    jnp.where(fad > 0, -1, fc)
+                ).astype(cfg.dtype)
+                fwait = fwait * (1 - consume_f)
+
+                # stash ring write at the lane-INDEPENDENT index t % D
+                old = lax.dynamic_index_in_dim(
+                    carry["stash"], t % D, axis=0, keepdims=False
+                )
+                stash = lax.dynamic_update_index_in_dim(
+                    carry["stash"], jnp.where(fwd_valid, x_in, old),
+                    t % D, axis=0,
+                )
+
+                w_f = jax.tree.map(
+                    lambda p: lax.dynamic_index_in_dim(
+                        p, jnp.clip(fc, 0, V - 1), axis=0, keepdims=False
+                    ),
+                    stage_layers,
+                )
+                y, aux_f = stage_fwd(w_f, x_in)
+                y = y.astype(cfg.dtype)
+
+                # ---- backward: consume waiting cotangent -----------------
+                bwd_valid = bc >= 0
+                sel_b = oh_stream(bc).astype(cfg.dtype)
+                dy_in = jnp.sum(sel_b * bwait, axis=0)
+                bwait = bwait * (1 - sel_b)
+
+                # ---- head (after bwd consumption, before its deposit) ----
+                head_valid = head_m >= 0
+                lab_h = lax.dynamic_index_in_dim(
+                    lab_all, jnp.clip(head_m, 0, M - 1), axis=0, keepdims=False
+                )
+                if split_head:
+                    y_bcast = _psum_pp(
+                        jnp.where(is_last & (ffin > 0), y, jnp.zeros_like(y))
+                    )
+
+                    def head_fn(hp, h):
+                        return self._head_loss_sum_slice(hp, h, lab_h, s, pp)
+
+                    loss_m, head_vjp = jax.vjp(head_fn, head_p, y_bcast)
+                    dhead, dh_slice = head_vjp(jnp.float32(1.0) / total_count)
+                    dh = _psum_pp(dh_slice)
+                    head_w = jnp.where(head_valid, 1.0, 0.0)
+                else:
+
+                    def head_fn(hp, h):
+                        return self._head_loss_sum(hp, h, lab_h)
+
+                    loss_m, head_vjp = jax.vjp(head_fn, head_p, y)
+                    dhead, dh = head_vjp(jnp.float32(1.0) / total_count)
+                    head_w = jnp.where(is_last & (ffin > 0), 1.0, 0.0)
+                loss_sum = carry["loss_sum"] + head_w * loss_m
+                # deposit dh into the LOCAL final-chunk cotangent slot on
+                # the last lane (the plan's phase-4 head landing)
+                dep = oh_stream(
+                    jnp.where(is_last & (ffin > 0), V - 1, -1)
+                ).astype(cfg.dtype)
+                bwait = bwait * (1 - dep) + dh.astype(cfg.dtype)[None] * dep
+
+                # ---- backward compute (manual VJP, stashed input) --------
+                x_saved = lax.dynamic_index_in_dim(
+                    stash, jnp.clip(bslot, 0, D - 1), axis=0, keepdims=False
+                )
+                w_b = jax.tree.map(
+                    lambda p: lax.dynamic_index_in_dim(
+                        p, jnp.clip(bc, 0, V - 1), axis=0, keepdims=False
+                    ),
+                    stage_layers,
+                )
+                _, stage_vjp = jax.vjp(
+                    lambda w, x: stage_fwd(w, x), w_b, x_saved
+                )
+                dw, dx = stage_vjp((dy_in.astype(cfg.dtype), aux_ct))
+
+                ids_b = lax.dynamic_index_in_dim(
+                    ids_all, jnp.clip(bm, 0, M - 1), axis=0, keepdims=False
+                )
+                _, embed_vjp = jax.vjp(lambda e: embed(e, ids_b), embed_p)
+                (dembed,) = embed_vjp(dx)
+
+                g = carry["grads"]
+                bwd_f = bwd_valid.astype(jnp.float32)
+                # one-hot accumulate into the (V, Lv, ...) chunk grads — a
+                # dynamic-index scatter-ADD here aborts the partitioner
+                oh_v = (jnp.arange(V) == bc).astype(jnp.float32)
+                grads = {
+                    "layers": jax.tree.map(
+                        lambda a, d: a
+                        + oh_v.reshape((V,) + (1,) * d.ndim)
+                        * (bwd_f * d.astype(jnp.float32))[None],
+                        g["layers"], dw,
+                    ),
+                    "head": jax.tree.map(
+                        lambda a, d: a + head_w * d.astype(jnp.float32),
+                        g["head"], dhead,
+                    ),
+                    "embed": jax.tree.map(
+                        lambda a, d: a
+                        + (bwd_f * (bfir > 0).astype(jnp.float32))
+                        * d.astype(jnp.float32),
+                        g["embed"], dembed,
+                    ),
+                }
+                aux_sum = carry["aux_sum"] + jnp.where(
+                    fwd_valid, aux_f.astype(jnp.float32), 0.0
+                )
+
+                # ---- exchange ----
+                inbox_f = lax.ppermute(y, PP_AXIS, fwd_perm)
+                inbox_b = lax.ppermute(dx.astype(cfg.dtype), PP_AXIS, bwd_perm)
+                return {
+                    "inbox_f": inbox_f,
+                    "inbox_b": inbox_b,
+                    "fwait": fwait,
+                    "bwait": bwait,
+                    "stash": stash,
+                    "grads": grads,
+                    "loss_sum": loss_sum,
+                    "aux_sum": aux_sum,
+                }, None
+
+            carry, _ = lax.scan(rotation, carry0, tables)
+            loss = lax.psum(carry["loss_sum"], PP_AXIS) / total_count
+            if moe:
+                aux_mean = lax.psum(carry["aux_sum"], PP_AXIS) / (pp * V * M)
+                loss = loss + cfg.router_aux_loss_coef * aux_mean
+            head_g = jax.tree.map(
+                lambda x: lax.psum(x, PP_AXIS), carry["grads"]["head"]
+            )
+            embed_g = jax.tree.map(
+                lambda x: lax.psum(x, PP_AXIS), carry["grads"]["embed"]
+            )
+            # restore the pp-shard dim for the P(None, PP_AXIS) out_spec
+            layers_g = jax.tree.map(
+                lambda g: g[:, None], carry["grads"]["layers"]
+            )
+            return layers_g, head_g, embed_g, loss
+
+        layer_specs = jax.tree.map(lambda _: P(None, PP_AXIS), params["layers"])
+        rep = jax.tree.map(lambda _: P(), head_params)
+
+        from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+            shardmap_cpu_bf16_workaround,
+        )
+
+        layers_in, restore_layers = shardmap_cpu_bf16_workaround(
+            params["layers"]
+        )
+
+        def lane_body_restored(layers_l, head_p, embed_p, ids_all, lab_all):
+            return lane_body(
+                restore_layers(layers_l), head_p, embed_p, ids_all, lab_all
+            )
+
+        layers_g, head_g, embed_g, loss = jax.shard_map(
+            lane_body_restored,
+            mesh=mesh,
+            in_specs=(layer_specs, rep, P(), P(), P()),
+            out_specs=(layer_specs, rep, P(), P()),
+            axis_names={PP_AXIS},
+            check_vma=False,
+        )(layers_in, head_params, params["embed"], ids_mb, lab_mb)
+
+        grads: Params = {
+            "layers": layers_g,
+            "final_norm": head_g["final_norm"],
+            "embed": jax.tree.map(
+                lambda a, b: a + b, embed_g, head_g["embed"]
+            ),
+        }
+        if "lm_head" in params:
+            grads["lm_head"] = head_g["lm_head"]
+        grads = jax.tree.map(
+            lambda g, sp: constrain(g, sp),
             grads,
             self.specs(),
             is_leaf=lambda x: isinstance(x, P),

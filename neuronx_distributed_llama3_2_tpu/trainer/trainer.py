@@ -151,10 +151,12 @@ def make_train_step(
     def loss_fn(params, input_ids, labels):
         return model.loss(params, input_ids, labels)
 
-    # a model exposing loss_and_grad computes its own gradients (the 1F1B
-    # pipeline interleaves fwd/bwd manually — autodiff can't express its
-    # schedule); otherwise differentiate the loss
-    if hasattr(model, "loss_and_grad") and getattr(model, "schedule", None) == "1f1b":
+    # a model exposing loss_and_grad computes its own gradients (the 1F1B /
+    # memory-bounded-interleaved pipelines interleave fwd/bwd manually —
+    # autodiff can't express their schedules); otherwise differentiate
+    if hasattr(model, "loss_and_grad") and getattr(
+        model, "uses_manual_vjp", getattr(model, "schedule", None) == "1f1b"
+    ):
         grad_fn = lambda p, ids, lbl: model.loss_and_grad(p, ids, lbl)  # noqa: E731
     else:
         grad_fn = jax.value_and_grad(loss_fn)

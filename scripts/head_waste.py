@@ -25,7 +25,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 
 def analytic_rows(seq: int = 8192):
-    import dataclasses
 
     from neuronx_distributed_llama3_2_tpu.models.llama import LLAMA_CONFIGS
 
