@@ -114,6 +114,11 @@ class MllamaTextConfig:
     rms_norm_eps: float = 1e-5
     max_seq_len: int = 8192
     dtype: Any = jnp.float32
+    # activation checkpointing over decoder layers ("none"/"full"/
+    # "selective" — the LlamaConfig policies): required for 11B training
+    # memory (docs/mllama_memory_plan.md); default off to keep small-model
+    # inference/parity paths recompute-free
+    remat: str = "none"
 
     @property
     def head_dim(self) -> int:
@@ -832,13 +837,27 @@ class MllamaForConditionalGeneration:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         sin, cos = precompute_rope(t.head_dim, s, t.rope_theta, t.rope_scaling)
         layer = self._self_layer()
+        xlayer = CrossAttentionDecoderLayer(t)
+
+        # vision_tokens / bias passed explicitly (not closure-captured):
+        # jax.checkpoint must see differentiated operands as arguments
+        def self_body(lp, x):
+            return layer(lp, x, sin, cos, positions)
+
+        def xattn_body(lp, x, vt):
+            return xlayer(lp, x, vt, bias, full_row)
+
+        from neuronx_distributed_llama3_2_tpu.models.llama import _remat_policy
+
+        policy = _remat_policy(t.remat)
+        if policy is not None:
+            self_body = jax.checkpoint(self_body, policy=policy)
+            xattn_body = jax.checkpoint(xattn_body, policy=policy)
         for i, lp in enumerate(params["layers"]):
             if i in t.cross_attention_layers:
-                x = CrossAttentionDecoderLayer(t)(
-                    lp, x, vision_tokens, bias, full_row
-                )
+                x = xattn_body(lp, x, vision_tokens)
             else:
-                x = layer(lp, x, sin, cos, positions)
+                x = self_body(lp, x)
         return RMSNorm(t.hidden_size, t.rms_norm_eps, t.dtype)(
             params["final_norm"], x
         )
