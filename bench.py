@@ -1,6 +1,14 @@
 """Benchmark: Llama-3.2 1B training throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints the headline JSON line {"metric", "value", "unit", "vs_baseline"},
+then — when the backend is healthy — spends the remaining session budget
+banking every staged chip measurement (scripts/chip_session.py stages:
+MFU margin sweep, chip-side TTFT 1B/3B, kernel gate, churn, 32K
+long-context, head/ring A/B default gates, ring-step timing), appending
+each record to CHIP_SESSION.jsonl and to stdout with the headline line
+re-echoed after every record. The driver only ever runs ``python
+bench.py``, so this is how a healthy relay window banks the whole session
+with no operator in the loop.
 
 Throughput definition replicates the reference's
 (examples/training/llama/training_utils.py:329-351: moving-window seqs/s,
@@ -133,6 +141,61 @@ METRIC_NAME = "llama3.2-1b_train_tokens_per_sec_per_chip"
 _TRANSIENT_MARKERS = ("UNAVAILABLE", "Unable to initialize", "DEADLINE_EXCEEDED")
 
 
+def _load_chip_session():
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts", "chip_session.py"
+    )
+    spec = importlib.util.spec_from_file_location("chip_session", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _post_session(headline: "str | None", start: float) -> None:
+    """Bank the whole staged chip session after a healthy headline run.
+
+    The driver only ever runs ``python bench.py`` (VERDICT r4 #1), so the
+    chip-session stages must ride this invocation: once the headline metric
+    is out, the remaining session budget (``BENCH_SESSION_DEADLINE_S``,
+    measured from process start) executes the ``scripts/chip_session.py``
+    stages in value-per-minute order — MFU margin sweep, chip-side TTFT,
+    kernel gate, serving churn, 32K long-context, the head/ring A/B
+    default gates, ring-step timing. Each stage's record is appended to
+    ``CHIP_SESSION.jsonl`` AND printed to stdout as it completes, with the
+    headline line re-echoed after every record so the stream's last
+    complete JSON line is always the driver metric, wherever a kill lands.
+    ``BENCH_SESSION=0`` disables (set automatically for session *stages*
+    so the bench stage of a manual chip_session run can't recurse).
+    """
+    if os.environ.get("BENCH_SESSION", "1") == "0":
+        return
+    total = float(os.environ.get("BENCH_SESSION_DEADLINE_S", "9000"))
+    remaining = total - (time.monotonic() - start)
+    if remaining < 180:
+        return
+    try:
+        cs = _load_chip_session()
+        # headline success already proved the backend is up — skip probe/bench
+        stages = [s for s in cs.STAGES if s[0] not in ("probe", "bench")]
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "CHIP_SESSION.jsonl"
+        )
+        cs.run_session(
+            stages,
+            deadline_s=remaining,
+            out_path=out_path,
+            stream=sys.stdout,
+            echo_line=headline,
+        )
+    except Exception as e:  # a broken session must never cost the headline:
+        # the driver keys on exit code, and the headline already printed
+        print(f"# chip session failed: {e}", file=sys.stderr, flush=True)
+        if headline:
+            print(headline, flush=True)
+
+
 def _probe_backend(timeout_s: float = 120.0) -> str:
     """Independent relay probe: bare ``jax.devices()`` in a bounded subprocess.
 
@@ -208,6 +271,7 @@ def main_with_retries(
     attempt_timeout_s: float | None = None,
     launch=_launch_once,
     probe=None,
+    post_session=lambda headline, start: None,
 ) -> None:
     """Retry transient relay outages, bounded in wall-clock.
 
@@ -248,6 +312,15 @@ def main_with_retries(
         if status == "ok":
             sys.stdout.write(out)
             sys.stdout.flush()
+            headline = next(
+                (
+                    ln
+                    for ln in reversed(out.strip().splitlines())
+                    if ln.strip().startswith("{")
+                ),
+                None,
+            )
+            post_session(headline, start)
             return
         tail = (out + "\n" + err)[-2000:]
         if status == "timeout":
@@ -280,4 +353,6 @@ if __name__ == "__main__":
     if "--once" in sys.argv[1:]:
         main()
     else:
-        main_with_retries()
+        # the driver entry point: headline metric first, then bank the
+        # staged chip session with the leftover budget (VERDICT r4 #1)
+        main_with_retries(post_session=_post_session)

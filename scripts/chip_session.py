@@ -12,10 +12,17 @@ JSON record to the session artifact (``CHIP_SESSION.jsonl``)::
     {"stage": ..., "rc": 0, "seconds": 12.3, "parsed": {...}, "tail": "..."}
 
 Stages (see ``STAGES``): relay probe → bench.py (the driver metric) →
-MFU sweep margin → chip-side TTFT 1B/3B → e2e latency report → serving
-churn → Pallas kernel gate → 32K long-context gate → ring-step timing.
-If the probe fails the session aborts immediately, recording the outage —
-nothing downstream can succeed without a backend.
+MFU sweep margin → chip-side TTFT 1B/3B → Pallas kernel gate → serving
+churn → 32K long-context gate → head/ring A/B default gates → e2e
+latency report → ring-step timing. If the probe fails the session aborts
+immediately, recording the outage — nothing downstream can succeed
+without a backend.
+
+This module is also the engine behind ``bench.py``'s post-headline
+session (``run_session``): the driver only ever runs ``python bench.py``,
+which, after a healthy headline run, executes these stages (minus
+probe/bench) with its leftover deadline budget — so a healthy relay
+window banks the full session with no operator in the loop.
 
 Usage::
 
@@ -58,16 +65,24 @@ STAGES = [
     ("ttft_prefill_3b",
      [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
       "--stage", "prefill", "--model", "llama3.2-3b"], 1500),
-    ("generate_1b",
-     [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
-      "--stage", "generate", "--model", "llama3.2-1b"], 900),
+    # A/B gates for the two CPU-calibrated defaults (VERDICT r4 #5) run
+    # BEFORE the longer gates: with worst-case stage timeouts the session
+    # budget can exhaust, and these two records are what the provisional
+    # defaults are explicitly waiting on
+    ("head_ab",
+     [PY, os.path.join(REPO, "scripts", "ab_stage.py"), "--which", "head"], 700),
+    ("ring_ab",
+     [PY, os.path.join(REPO, "scripts", "ab_stage.py"), "--which", "ring"], 900),
+    ("kernel_gate",
+     [PY, os.path.join(REPO, "scripts", "tpu_kernel_gate.py")], 1200),
     ("churn_1b",
      [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
       "--stage", "churn", "--model", "llama3.2-1b"], 900),
-    ("kernel_gate",
-     [PY, os.path.join(REPO, "scripts", "tpu_kernel_gate.py")], 1200),
     ("long_context",
      [PY, os.path.join(REPO, "scripts", "long_context_gate.py")], 1800),
+    ("generate_1b",
+     [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
+      "--stage", "generate", "--model", "llama3.2-1b"], 900),
     ("ring_step_timing",
      [PY, os.path.join(REPO, "scripts", "ring_step_bench.py")], 1500),
 ]
@@ -87,6 +102,10 @@ def last_json_line(text: str):
 
 def run_stage(name: str, argv: list, timeout_s: float) -> dict:
     env = dict(os.environ)
+    # stages never start their own nested session (bench.py runs one
+    # post-headline when invoked by the driver; as a session *stage* it
+    # must emit only its metric)
+    env["BENCH_SESSION"] = "0"
     if name == "bench":
         # keep bench.py's internal retry deadline strictly inside this
         # stage's timeout — an env override (BENCH_DEADLINE_S) larger than
@@ -121,6 +140,58 @@ def run_stage(name: str, argv: list, timeout_s: float) -> dict:
     }
 
 
+def run_session(
+    stages,
+    deadline_s: float,
+    out_path: str,
+    stream=None,
+    echo_line: "str | None" = None,
+    stage_runner=run_stage,
+):
+    """Run ``stages`` (name, argv, timeout) within ``deadline_s``, appending
+    one JSON record per stage to ``out_path``.
+
+    With ``stream`` set, each record is also printed there as a compact JSON
+    line as soon as the stage completes — the bank-as-you-go contract: a
+    mid-session kill loses only the stage in flight, never completed
+    records. ``echo_line`` (the bench headline) is re-printed after every
+    record so the stream's last complete JSON line stays the driver metric
+    no matter where a kill lands. Returns ``(results, aborted)``.
+    """
+    start = time.monotonic()
+    results = []
+    aborted = None
+    with open(out_path, "a") as f:
+        f.write(json.dumps({
+            "session_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "stages": [s[0] for s in stages],
+        }) + "\n")
+        f.flush()
+        for name, argv, timeout_s in stages:
+            remaining = deadline_s - (time.monotonic() - start)
+            if remaining <= 30:
+                aborted = f"deadline exhausted before stage {name}"
+                break
+            rec = stage_runner(name, argv, min(timeout_s, remaining))
+            results.append(rec)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            if stream is not None:
+                slim = dict(rec)
+                slim["tail"] = slim["tail"][-400:]
+                print(json.dumps(slim), file=stream, flush=True)
+                if echo_line:
+                    print(echo_line, file=stream, flush=True)
+            print(f"[{rec['status']:>7}] {name} ({rec['seconds']}s)",
+                  file=sys.stderr, flush=True)
+            if name == "probe" and rec["status"] != "ok":
+                aborted = f"relay probe {rec['status']} — backend down, aborting"
+                break
+        if aborted:
+            f.write(json.dumps({"aborted": aborted}) + "\n")
+    return results, aborted
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(REPO, "CHIP_SESSION.jsonl"))
@@ -144,31 +215,7 @@ def main() -> int:
                      f"(see --list for valid names)")
     stages = [s for s in STAGES if chosen is None or s[0] in chosen]
 
-    start = time.monotonic()
-    results = []
-    aborted = None
-    with open(args.out, "a") as f:
-        f.write(json.dumps({
-            "session_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
-            "stages": [s[0] for s in stages],
-        }) + "\n")
-        f.flush()
-        for name, argv, timeout_s in stages:
-            remaining = args.deadline - (time.monotonic() - start)
-            if remaining <= 30:
-                aborted = f"deadline exhausted before stage {name}"
-                break
-            rec = run_stage(name, argv, min(timeout_s, remaining))
-            results.append(rec)
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            print(f"[{rec['status']:>7}] {name} ({rec['seconds']}s)",
-                  file=sys.stderr, flush=True)
-            if name == "probe" and rec["status"] != "ok":
-                aborted = f"relay probe {rec['status']} — backend down, aborting"
-                break
-        if aborted:
-            f.write(json.dumps({"aborted": aborted}) + "\n")
+    results, aborted = run_session(stages, args.deadline, args.out)
 
     ok = sum(1 for r in results if r["status"] == "ok")
     print(json.dumps({

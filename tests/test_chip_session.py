@@ -1,0 +1,291 @@
+"""Tests for the measurement tooling itself (VERDICT r4 #3).
+
+The chip-session orchestrator is the one tool whose job is to never waste
+a healthy-relay window, and bench.py's post-headline hook is how the
+driver's ``python bench.py`` invocation banks the whole session — both
+must be exercised by the suite, not just trusted. Stages here are stubbed
+(fast fake subprocesses / injected runners); the real stage scripts get
+separate --cpu --quick smoke tests.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, rel):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, rel)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def cs():
+    return _load("chip_session_mod", "scripts/chip_session.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    return _load("bench_session_mod", "bench.py")
+
+
+RECORD_KEYS = {"stage", "status", "rc", "seconds", "parsed", "tail"}
+
+
+def _stub_runner(script):
+    """Stage runner returning scripted records (no subprocesses)."""
+
+    def run(name, argv, timeout_s):
+        rec = {
+            "stage": name,
+            "status": "ok",
+            "rc": 0,
+            "seconds": 0.1,
+            "parsed": {"metric": name},
+            "tail": f"{name} done",
+        }
+        rec.update(script.get(name, {}))
+        return rec
+
+    return run
+
+
+def test_run_session_record_schema_and_file(tmp_path, cs):
+    out = tmp_path / "session.jsonl"
+    stages = [("a", ["true"], 10), ("b", ["true"], 10)]
+    results, aborted = cs.run_session(
+        stages, deadline_s=60, out_path=str(out), stage_runner=_stub_runner({})
+    )
+    assert aborted is None
+    assert [r["stage"] for r in results] == ["a", "b"]
+    for r in results:
+        assert set(r) == RECORD_KEYS
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert lines[0]["stages"] == ["a", "b"]  # session header
+    assert [ln["stage"] for ln in lines[1:]] == ["a", "b"]
+
+
+def test_run_session_aborts_on_probe_failure(tmp_path, cs):
+    """A dead relay must abort the session immediately — nothing downstream
+    can succeed, and burning stage timeouts against a dead backend is the
+    round-2 failure mode."""
+    out = tmp_path / "s.jsonl"
+    stages = [("probe", ["true"], 10), ("bench", ["true"], 10)]
+    results, aborted = cs.run_session(
+        stages,
+        deadline_s=60,
+        out_path=str(out),
+        stage_runner=_stub_runner({"probe": {"status": "timeout", "rc": None}}),
+    )
+    assert aborted is not None and "probe" in aborted
+    assert [r["stage"] for r in results] == ["probe"]  # bench never ran
+    assert json.loads(out.read_text().splitlines()[-1])["aborted"] == aborted
+
+
+def test_run_session_deadline_exhaustion(tmp_path, cs):
+    import time as _time
+
+    def slow_runner(name, argv, timeout_s):
+        _time.sleep(0.2)
+        return _stub_runner({})(name, argv, timeout_s)
+
+    results, aborted = cs.run_session(
+        [("a", ["true"], 10), ("b", ["true"], 10)],
+        deadline_s=30.2,  # stage a's 0.2 s leaves < 30 s — b must not start
+        out_path=str(tmp_path / "s.jsonl"),
+        stage_runner=slow_runner,
+    )
+    assert [r["stage"] for r in results] == ["a"]
+    assert "deadline exhausted" in aborted and "b" in aborted
+
+
+def test_run_session_streams_records_and_echoes_headline(tmp_path, cs, capsys):
+    """Bank-as-you-go: every record prints as it completes, and the
+    headline is re-echoed after each one so the stream's last complete
+    JSON line is the driver metric wherever a kill lands."""
+    headline = json.dumps({"metric": "m", "value": 1.0, "vs_baseline": 1.01})
+    cs.run_session(
+        [("a", ["true"], 10), ("b", ["true"], 10)],
+        deadline_s=60,
+        out_path=str(tmp_path / "s.jsonl"),
+        stream=sys.stdout,
+        echo_line=headline,
+        stage_runner=_stub_runner({}),
+    )
+    out_lines = capsys.readouterr().out.strip().splitlines()
+    parsed = [json.loads(ln) for ln in out_lines]
+    assert [p.get("stage", "HEADLINE") for p in parsed] == [
+        "a", "HEADLINE", "b", "HEADLINE"
+    ]
+    assert parsed[-1] == json.loads(headline)
+
+
+def test_run_stage_disables_nested_session_and_bounds_timeout(cs):
+    """Real-subprocess checks: stage children inherit BENCH_SESSION=0 (the
+    bench stage of a manual session must not recurse into its own
+    session), and a hung stage is killed at its bound with a parseable
+    timeout record."""
+    rec = cs.run_stage(
+        "envcheck",
+        [sys.executable, "-c",
+         "import os, json; print(json.dumps({'sess': os.environ['BENCH_SESSION']}))"],
+        30,
+    )
+    assert rec["status"] == "ok" and rec["parsed"] == {"sess": "0"}
+
+    rec = cs.run_stage(
+        "hang", [sys.executable, "-c", "import time; time.sleep(30)"], 1.0
+    )
+    assert rec["status"] == "timeout" and rec["rc"] is None
+    assert set(rec) == RECORD_KEYS
+
+
+def test_run_stage_records_launch_error(cs):
+    rec = cs.run_stage("gone", ["/nonexistent/stage-script"], 5)
+    assert rec["status"] == "launch_error"
+    assert set(rec) == RECORD_KEYS
+
+
+def test_bench_ok_path_invokes_post_session_with_headline(bench, capsys):
+    """main_with_retries must hand the post-session hook the headline line
+    (not the whole stdout) and the loop's start time."""
+    good = json.dumps({"metric": bench.METRIC_NAME, "value": 1.0,
+                       "unit": "tokens/s", "vs_baseline": 1.02})
+    seen = {}
+
+    def post(headline, start):
+        seen["headline"] = headline
+        seen["start"] = start
+
+    bench.main_with_retries(
+        attempts=1, backoff_s=0, deadline_s=30, attempt_timeout_s=10,
+        launch=lambda t: ("ok", "# chatter\n" + good + "\n", ""),
+        post_session=post,
+    )
+    assert json.loads(seen["headline"]) == json.loads(good)
+    assert isinstance(seen["start"], float)
+    capsys.readouterr()
+
+
+def test_bench_failure_path_skips_post_session(bench, capsys):
+    called = []
+    with pytest.raises(SystemExit):
+        bench.main_with_retries(
+            attempts=1, backoff_s=0, deadline_s=30, attempt_timeout_s=10,
+            launch=lambda t: ("error", "", "UNAVAILABLE: down"),
+            probe=lambda: "backend_init_timeout",
+            post_session=lambda *a: called.append(a),
+        )
+    assert not called
+    capsys.readouterr()
+
+
+def test_post_session_env_gate_and_budget(bench, monkeypatch):
+    """BENCH_SESSION=0 and an exhausted budget must both skip the session
+    without importing chip_session (a broken session can never cost the
+    headline)."""
+    import time as _time
+
+    def boom():
+        raise AssertionError("chip_session must not be loaded")
+
+    monkeypatch.setattr(bench, "_load_chip_session", boom)
+    monkeypatch.setenv("BENCH_SESSION", "0")
+    bench._post_session("{}", _time.monotonic())
+
+    monkeypatch.setenv("BENCH_SESSION", "1")
+    monkeypatch.setenv("BENCH_SESSION_DEADLINE_S", "100")
+    bench._post_session("{}", _time.monotonic() - 99.0)  # < 180 s left
+
+
+def test_post_session_runs_stages_minus_probe_and_bench(bench, monkeypatch,
+                                                        tmp_path):
+    """The post-headline session must run the chip-session stage list
+    minus probe (headline success already proved the backend) and bench
+    (just ran), streaming to stdout with the headline echoed."""
+    calls = {}
+
+    class FakeCS:
+        STAGES = [("probe", ["p"], 1), ("bench", ["b"], 1),
+                  ("mfu_sweep", ["m"], 1), ("head_ab", ["h"], 1)]
+
+        @staticmethod
+        def run_session(stages, deadline_s, out_path, stream, echo_line):
+            calls["stages"] = [s[0] for s in stages]
+            calls["deadline"] = deadline_s
+            calls["echo"] = echo_line
+            return [], None
+
+    monkeypatch.setattr(bench, "_load_chip_session", lambda: FakeCS)
+    monkeypatch.delenv("BENCH_SESSION", raising=False)
+    monkeypatch.setenv("BENCH_SESSION_DEADLINE_S", "1000")
+    import time as _time
+
+    bench._post_session('{"metric": "x"}', _time.monotonic())
+    assert calls["stages"] == ["mfu_sweep", "head_ab"]
+    assert 900 < calls["deadline"] <= 1000
+    assert calls["echo"] == '{"metric": "x"}'
+
+
+def test_session_stage_list_covers_verdict_requirements(cs):
+    """The banked-session contract (VERDICT r4 #1 + #5): MFU margin,
+    chip-side TTFT 1B/3B, churn, kernel gate, long-context, ring-step,
+    and the two A/B default gates must all be staged."""
+    names = {s[0] for s in cs.STAGES}
+    assert {
+        "probe", "bench", "mfu_sweep", "ttft_prefill_1b", "ttft_prefill_3b",
+        "churn_1b", "kernel_gate", "long_context", "ring_step_timing",
+        "head_ab", "ring_ab",
+    } <= names
+
+
+@pytest.mark.parametrize("which,timeout", [("head", 180), ("ring", 300)])
+def test_ab_stage_smoke(which, timeout):
+    """The A/B stage scripts run end-to-end on the CPU plumbing tier and
+    emit one parseable JSON record with the comparison fields."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "ab_stage.py"),
+         "--which", which, "--cpu", "--quick", "--iters", "1"],
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    if which == "head":
+        assert rec["ab"] == "head_sequence_split"
+        assert rec["ici_unmeasured"] is True
+        assert rec["split_fwdbwd_ms"] > 0 and rec["unsplit_fwdbwd_ms"] > 0
+    else:
+        assert rec["ab"] == "ring_zigzag_vs_contiguous"
+        row = rec["rows"][0]
+        assert row["critical_contiguous_fwdbwd_ms"] > 0
+        assert row["critical_zigzag_fwdbwd_ms"] > 0
+
+
+def test_mllama_memory_plan_skip_measure_smoke():
+    """The 11B memory-plan script's exact accounting path runs and emits
+    the static byte plan (VERDICT r4 #3; the full measured path is the
+    docs/mllama_memory_plan.md deliverable)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "mllama_memory_plan.py"),
+         "--skip-measure"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    exact = rec["exact"]
+    assert exact["mesh"] == {"tp": 8, "dp": 8}
+    assert exact["n_params_B"] > 9  # the 11B model, not a stub
+    for k in ("bf16_params_GB_per_chip", "zero1_master_fp32_GB_per_chip",
+              "zero1_moments_fp32_GB_per_chip", "grads_GB_per_chip",
+              "static_total_GB_per_chip"):
+        assert exact[k] > 0
+    assert exact["static_total_GB_per_chip"] < rec["hbm_per_chip_GB"]
