@@ -407,34 +407,53 @@ class PipelinedCausalLM:
         layers_in, restore_layers = shardmap_cpu_bf16_workaround(params["layers"])
         x_mb, restore_x = shardmap_cpu_bf16_workaround(x_mb)
 
+        # static plan → (R, pp) gather tables scanned by a UNIFORM rotation
+        # body: program size O(1) in M·V (VERDICT r4 #4; the reference's
+        # schedule is likewise a constant-size per-task loop,
+        # scheduler.py:256). Receiver-side routing (in_slot) and stream
+        # exits are derived per rotation on the host, like the sender-side
+        # columns.
+        tables = {
+            "chunk": jnp.asarray([st.chunk for st in plan.steps_], jnp.int32),
+            "mb": jnp.asarray([st.mb for st in plan.steps_], jnp.int32),
+            "admit": jnp.asarray([st.admit for st in plan.steps_], jnp.int32),
+            # lane d's inbound stream comes from lane d-1 and lands in the
+            # chunk slot the sender computed
+            "in_slot": jnp.asarray(
+                [
+                    [st.out_slot[(d - 1) % pp] for d in range(pp)]
+                    for st in plan.steps_
+                ],
+                jnp.int32,
+            ),
+            # a stream exits when its output is not stored anywhere
+            # (out_slot -1) while the lane ran a real microbatch
+            "exits": jnp.asarray(
+                [
+                    [
+                        1 if (st.out_slot[d] == -1 and st.mb[d] >= 0) else 0
+                        for d in range(pp)
+                    ]
+                    for st in plan.steps_
+                ],
+                jnp.int32,
+            ),
+        }
+
         def lane_body(layers_l, x_all):
             layers_l = restore_layers(layers_l)
             x_all = restore_x(x_all)
             # pp-manual leaves arrive (V, 1, Lv, ...); drop the lane dim
             layers_lane = jax.tree.map(lambda p: p[:, 0], layers_l)
             s = lax.axis_index(PP_AXIS)
-            slots = jnp.zeros((V, mbs, S, H), cfg.dtype)
-            out_buf = jnp.zeros((M, mbs, S, H), cfg.dtype)
-            aux_sum = jnp.float32(0.0)
-            for step in plan.steps_:
-                chunk_a = jnp.asarray(step.chunk, jnp.int32)[s]
-                mb_a = jnp.asarray(step.mb, jnp.int32)[s]
-                admit_a = jnp.asarray(step.admit, jnp.int32)[s]
-                # receiver-side routing: lane d's inbound stream comes from
-                # lane d-1 and lands in the chunk slot the sender computed
-                in_slot = jnp.asarray(
-                    [step.out_slot[(d - 1) % pp] for d in range(pp)],
-                    jnp.int32,
-                )[s]
-                # a stream exits when its output is not stored anywhere
-                # (out_slot -1) while the lane ran a real microbatch
-                exits = jnp.asarray(
-                    [
-                        1 if (step.out_slot[d] == -1 and step.mb[d] >= 0) else 0
-                        for d in range(pp)
-                    ],
-                    jnp.int32,
-                )[s]
+
+            def rotation(carry, xs):
+                slots, out_buf, aux_sum = carry
+                chunk_a = xs["chunk"][s]
+                mb_a = xs["mb"][s]
+                admit_a = xs["admit"][s]
+                in_slot = xs["in_slot"][s]
+                exits = xs["exits"][s]
 
                 c_cl = jnp.clip(chunk_a, 0, V - 1)
                 x_slot = lax.dynamic_index_in_dim(
@@ -475,6 +494,14 @@ class PipelinedCausalLM:
                 slots = lax.dynamic_update_index_in_dim(
                     slots, jnp.where(in_slot >= 0, recv, cur_slot), in_cl, axis=0
                 )
+                return (slots, out_buf, aux_sum), None
+
+            carry0 = (
+                jnp.zeros((V, mbs, S, H), cfg.dtype),
+                jnp.zeros((M, mbs, S, H), cfg.dtype),
+                jnp.float32(0.0),
+            )
+            (slots, out_buf, aux_sum), _ = lax.scan(rotation, carry0, tables)
             return out_buf[None], aux_sum[None]
 
         layer_specs = jax.tree.map(lambda _: P(None, PP_AXIS), params["layers"])
