@@ -122,6 +122,29 @@ def _chunk_file(kind: str, key: str, index: tuple) -> str:
     return f"{kind}/{key.replace(_SEP, '.')}.shard.{span}.npy"
 
 
+def plan_chunk_writers(shape, sharding) -> Dict[tuple, Any]:
+    """Distinct chunks of ``sharding`` over ``shape`` with the DEVICE that
+    will write each under the sharded-save protocol.
+
+    The writer of a chunk is its replica-0 holder: jax assigns
+    ``Shard.replica_id`` by position in the sharding's device-assignment
+    order (``mesh.devices.flat`` for NamedSharding), so the first device in
+    that order holding a given global index writes it. This is the planning
+    mirror of :func:`_chunk_plan`'s ``replica_id == 0`` filter — used by
+    ``scripts/ckpt_byte_plan.py`` for the 70B per-process byte accounting,
+    and validated against actual multi-process writes in
+    ``tests/multihost_worker.py``. Returns {normalized_index: device}."""
+    shape = tuple(shape)
+    pos = {d: i for i, d in enumerate(sharding.mesh.devices.flat)}
+    owners: Dict[tuple, Any] = {}
+    for dev, index in sharding.devices_indices_map(shape).items():
+        norm = _norm_index(index, shape)
+        cur = owners.get(norm)
+        if cur is None or pos[dev] < pos[cur]:
+            owners[norm] = dev
+    return owners
+
+
 def _chunk_plan(leaf, kind: str, key: str):
     """(all_chunks, local_payload) for a non-fully-addressable array.
 

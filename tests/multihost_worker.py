@@ -157,6 +157,49 @@ def main() -> None:
     # the dp-sharded ZeRO-1 state must split real bytes across BOTH hosts
     assert my_bytes > 0, my_bytes
 
+    # -- replica-0 owner rule + replicated-leaf concentration -------------
+    # (VERDICT r4 #6) The 70B byte plan (scripts/ckpt_byte_plan.py) predicts
+    # per-process writes with plan_chunk_writers' "first device in mesh
+    # order holding the chunk" rule. Validate it against what THIS real
+    # two-process save actually wrote: the predicted chunk-file set per
+    # process must equal the observed one, exactly.
+    from neuronx_distributed_llama3_2_tpu.checkpoint.checkpoint import (
+        _chunk_file,
+        _flatten,
+        plan_chunk_writers,
+    )
+
+    predicted = {0: set(), 1: set()}
+    for kind, tree in (("model", state.params), ("optim", state.opt)):
+        for key, leaf in _flatten(tree).items():
+            if leaf is None or not hasattr(leaf, "sharding"):
+                continue
+            if leaf.is_fully_addressable:
+                continue  # written whole by process 0, not as chunks
+            for norm, dev in plan_chunk_writers(
+                leaf.shape, leaf.sharding
+            ).items():
+                predicted[dev.process_index].add(
+                    "mh/" + _chunk_file(kind, key, norm)
+                )
+    my_chunks = {p for p, _ in written if ".shard." in p and p.endswith(".npy")}
+    assert my_chunks == predicted[pid], (
+        f"owner-rule mismatch on process {pid}: "
+        f"{sorted(my_chunks ^ predicted[pid])[:6]}"
+    )
+    # whole-array files (fully-addressable leaves + manifests) are the
+    # replicated-concentration class: process 0 only, and in this model
+    # they must be a small fraction of process 0's total bytes
+    whole = [(p, b) for p, b in written if ".shard." not in p]
+    if pid != 0:
+        assert not whole, whole
+    else:
+        whole_bytes = sum(b for _, b in whole)
+        assert whole_bytes < 0.5 * my_bytes, (
+            f"replicated/whole-array writes dominate process 0 "
+            f"({whole_bytes}/{my_bytes} bytes) — time to spread ownership"
+        )
+
     # sharded load-back: specs + mesh → make_array_from_callback assembles
     # each process's regions from local chunk reads; values must round-trip
     template = jax.eval_shape(model.init, jax.random.key(0))

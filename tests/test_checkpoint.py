@@ -195,3 +195,72 @@ def test_sharded_chunk_region_assembly(tmp_path):
     bad = {**entry, "chunks": chunks[:3]}
     with pytest.raises(ValueError, match="do not cover"):
         _read_region(storage, "t", bad, ((0, 8), (0, 6)), {})
+
+
+def test_ckpt_byte_plan_accounting_in_sync():
+    """The 70B byte plan's accounting trees must stay congruent with
+    model.specs()/optimizer_state_specs (VERDICT r4 #6): compute_plan zips
+    eval_shape leaves against spec leaves and asserts the counts match, so
+    any drift between the model tree and its specs fails here. Run on the
+    8-device test mesh at tp=2 x pp=4 with the tiny model."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "ckpt_byte_plan",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "ckpt_byte_plan.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    plan = mod.compute_plan(
+        devices_per_process=4, model_name="tiny", tp=2, pp=4,
+        num_microbatches=2,
+    )
+    assert plan["processes"] == 2
+    per = plan["per_process_bytes"]
+    assert len(per) == 2 and all(b > 0 for b in per)
+    assert abs(sum(per) - plan["total_bytes"]) <= len(per)  # int truncation
+    # tiny/fp32: params + master + mu + nu = 4 x param bytes (all fp32)
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+    )
+
+    abstract = jax.eval_shape(
+        LlamaForCausalLM(LLAMA_CONFIGS["tiny"]).init, jax.random.key(0)
+    )
+    param_bytes = sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree.leaves(abstract)
+    )
+    assert abs(plan["total_bytes"] - 4 * param_bytes) < 1e-3 * param_bytes
+
+
+def test_ckpt_byte_plan_70b_balance():
+    """The deliverable numbers (docs/ckpt_byte_plan.md): per-process write
+    bytes for llama3-70b at tp=8 x pp=8 over 16 processes stay balanced
+    within 1.5x of the mean — the bound past which replica-spreading
+    ownership becomes worth implementing."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "scripts",
+                      "ckpt_byte_plan.py")],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    plan = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert plan["processes"] == 16
+    assert plan["imbalance_max_over_mean"] < 1.5, plan
+    assert plan["total_GB"] > 800  # 70B params bf16 + 3x fp32 opt state
+    # process 0's exclusive whole-array writes stay metadata-sized
+    assert plan["replicated_GB_on_proc0"] < 0.1, plan
