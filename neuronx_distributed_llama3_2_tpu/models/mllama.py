@@ -82,6 +82,11 @@ class MllamaVisionConfig:
     intermediate_layers_indices: Tuple[int, ...] = (3, 7, 15, 23, 30)
     norm_eps: float = 1e-5
     dtype: Any = jnp.float32
+    # activation checkpointing over the 40 vision layers. The tower runs a
+    # plain layer loop (heterogeneous gated/ungated blocks), and its
+    # (BM, heads, 4128, 4128) attention activations dominate 11B training
+    # memory without remat — docs/mllama_memory_plan.md quantifies.
+    remat: str = "none"
 
     @property
     def num_patches(self) -> int:
@@ -531,9 +536,27 @@ class MllamaVisionModel:
         bias = bias[:, None, :, :]  # (BM, 1, S, S)
 
         hidden = hidden.reshape(b * m, t * tlen, c.hidden_size)
+
+        # per-layer remat: differentiated operands (layer params, hidden,
+        # bias) enter as explicit jax.checkpoint arguments (same rule as
+        # the text side, line ~843)
+        from neuronx_distributed_llama3_2_tpu.models.llama import _remat_policy
+
+        policy = _remat_policy(c.remat)
+
+        def plain_body(lp, h, bias):
+            return VisionEncoderLayer(c, is_gated=False)(lp, h, bias)
+
+        def gated_body(lp, h, bias):
+            return VisionEncoderLayer(c, is_gated=True)(lp, h, bias)
+
+        if policy is not None:
+            plain_body = jax.checkpoint(plain_body, policy=policy)
+            gated_body = jax.checkpoint(gated_body, policy=policy)
+
         intermediates: List[jax.Array] = []
         for i, lp in enumerate(params["transformer"]):
-            hidden = VisionEncoderLayer(c, is_gated=False)(lp, hidden, bias)
+            hidden = plain_body(lp, hidden, bias)
             if i in c.intermediate_layers_indices:
                 intermediates.append(hidden)
 
@@ -546,7 +569,7 @@ class MllamaVisionModel:
         )
         hidden = hidden.reshape(b * m, t * tlen, c.hidden_size)
         for lp in params["global_transformer"]:
-            hidden = VisionEncoderLayer(c, is_gated=True)(lp, hidden, bias)
+            hidden = gated_body(lp, hidden, bias)
 
         # strip padding, collect (final, intermediates)
         hidden = hidden.reshape(b * m, t, tlen, c.hidden_size)[:, :, :n_pat]

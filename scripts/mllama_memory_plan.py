@@ -18,10 +18,13 @@ Two measurement classes:
    implementation).
 2. **Measured** activation anchors: XLA `memory_analysis().temp_size` of
    the compiled `value_and_grad(loss)` at scaled-down configs (same
-   hidden/head geometry as 11B, fewer layers / shorter seq), establishing
-   the per-layer-token activation coefficient under remat=full; the plan
-   extrapolates linearly in L·B·S (the remat=full boundary-stash model)
-   and reports the fit residual between anchors.
+   hidden/head geometry as 11B) varying VISION depth, text depth and
+   sequence length independently, with remat=full on BOTH towers. A
+   linear model in (Nv, Lt, Lt·S, S) is least-squares fit with one anchor
+   held out; the held-out residual scales the extrapolation as an
+   honesty margin. (The round-4 version varied only text depth and seq —
+   its own S anchor contradicted its linear-in-S model with residual 1.0,
+   because the un-rematted vision tower dominated the base.)
 
 Usage: python scripts/mllama_memory_plan.py [--skip-measure]
 Prints ONE JSON line.
@@ -124,9 +127,10 @@ def exact_param_plan():
     }
 
 
-def measured_activation_anchors():
-    """temp_size of compiled value_and_grad at 11B hidden geometry, scaled
-    layer counts / seq — the activation coefficient under remat=full."""
+def _measure_one(nv_plain, nv_global, lt, seq):
+    """temp_size of the compiled value_and_grad at 11B hidden geometry with
+    ``nv_plain``+``nv_global`` vision layers, ``lt`` text layers, ``seq``
+    tokens, vision AND text remat=full — one anchor, in GB."""
     import dataclasses as dc
 
     import jax
@@ -136,80 +140,105 @@ def measured_activation_anchors():
     from neuronx_distributed_llama3_2_tpu.models.mllama import (
         MLLAMA_CONFIGS,
         MllamaForConditionalGeneration,
-        MllamaTextConfig,
-        MllamaVisionConfig,
     )
-    from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
     from neuronx_distributed_llama3_2_tpu.parallel.layers import shard_pytree
+
+    full = MLLAMA_CONFIGS["llama3.2-11b-vision"]
+    xl = tuple(i for i in (1,) if i < lt)
+    cfg = dc.replace(
+        full,
+        vision=dc.replace(
+            full.vision, num_hidden_layers=nv_plain,
+            num_global_layers=nv_global,
+            intermediate_layers_indices=tuple(range(min(2, nv_plain))),
+            dtype=jnp.bfloat16, remat="full",
+        ),
+        text=dc.replace(
+            full.text, num_hidden_layers=lt, cross_attention_layers=xl,
+            max_seq_len=max(seq, 2048), remat="full", dtype=jnp.bfloat16,
+        ),
+    )
+    model = MllamaForConditionalGeneration(cfg)
+    params = shard_pytree(
+        jax.jit(model.init)(jax.random.key(0)), model.specs()
+    )
+    b = 1
+    rng = np.random.default_rng(0)
+    pix = jnp.asarray(
+        rng.standard_normal(
+            (b, 1, cfg.vision.max_num_tiles, 3,
+             cfg.vision.image_size, cfg.vision.image_size)
+        ),
+        jnp.bfloat16,
+    )
+    ids = jnp.asarray(rng.integers(0, cfg.text.vocab_size, (b, seq)), jnp.int32)
+    ar_ids = jnp.asarray([[1]], jnp.int32)
+    ar_mask = jnp.ones((b, 1, cfg.vision.max_num_tiles), jnp.int32)
+    xmask = jnp.ones((b, seq, 1, cfg.vision.max_num_tiles), jnp.int32)
+
+    fn = jax.jit(jax.value_and_grad(
+        lambda p: model.loss(p, ids, ids, pix, ar_ids, ar_mask, xmask)
+    ))
+    ma = fn.lower(params).compile().memory_analysis()
+    return ma.temp_size_in_bytes / 2**30
+
+
+def measured_activation_anchors():
+    """Fit temp ≈ c0 + cv·Nv + ct·Lt + cls·Lt·S + cs·S from measured
+    anchors varying vision depth, text depth and sequence length
+    independently (the round-4 script varied only Lt and S and its single
+    S anchor CONTRADICTED its linear-in-S model, residual 1.0 — vision
+    dominated the base and was never varied). One anchor is held out of
+    the fit and reported as the honest extrapolation residual."""
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
 
     parallel_state.destroy_model_parallel()
     parallel_state.initialize_model_parallel(tensor_model_parallel_size=8)
 
-    full = MLLAMA_CONFIGS["llama3.2-11b-vision"]
+    # (nv_plain, nv_global, lt, seq); the last row is held out of the fit
+    grid = [
+        (2, 1, 2, 1024),
+        (4, 2, 2, 1024),
+        (2, 1, 4, 1024),
+        (2, 1, 2, 2048),
+        (2, 1, 4, 2048),
+        (4, 2, 4, 2048),  # held-out validation anchor
+    ]
     anchors = []
-    for L, S in ((2, 1024), (4, 1024), (4, 2048)):
-        xl = tuple(i for i in (1,) if i < L)
-        cfg = dc.replace(
-            full,
-            vision=dc.replace(
-                full.vision, num_hidden_layers=2, num_global_layers=1,
-                intermediate_layers_indices=(0, 1), dtype=jnp.bfloat16,
-            ),
-            text=dc.replace(
-                full.text, num_hidden_layers=L, cross_attention_layers=xl,
-                max_seq_len=max(S, 2048), remat="full", dtype=jnp.bfloat16,
-            ),
-        )
-        model = MllamaForConditionalGeneration(cfg)
-        params = shard_pytree(
-            jax.jit(model.init)(jax.random.key(0)), model.specs()
-        )
-        b = 1
-        rng = np.random.default_rng(0)
-        pix = jnp.asarray(
-            rng.standard_normal(
-                (b, 1, cfg.vision.max_num_tiles, 3,
-                 cfg.vision.image_size, cfg.vision.image_size)
-            ),
-            jnp.bfloat16,
-        )
-        ids = jnp.asarray(
-            rng.integers(0, cfg.text.vocab_size, (b, S)), jnp.int32
-        )
-        ar_ids = jnp.asarray([[1]], jnp.int32)
-        ar_mask = jnp.ones((b, 1, cfg.vision.max_num_tiles), jnp.int32)
-        xmask = jnp.ones(
-            (b, S, 1, cfg.vision.max_num_tiles), jnp.int32
-        )
-
-        fn = jax.jit(jax.value_and_grad(
-            lambda p: model.loss(p, ids, ids, pix, ar_ids, ar_mask, xmask)
-        ))
-        ma = fn.lower(params).compile().memory_analysis()
+    for nv_p, nv_g, lt, seq in grid:
+        t = _measure_one(nv_p, nv_g, lt, seq)
         anchors.append({
-            "layers": L, "seq": S, "batch": b,
-            "temp_GB": round(ma.temp_size_in_bytes / 2**30, 4),
+            "vision_layers": nv_p + nv_g, "text_layers": lt, "seq": seq,
+            "batch": 1, "temp_GB": round(t, 4),
         })
     parallel_state.destroy_model_parallel()
 
-    # remat=full model: temp ≈ base + k · L · B · S  (boundary stash +
-    # per-layer recompute working set). Solve k from the L anchors and
-    # check the S anchor against it.
-    a2, a4, a4s = anchors
-    k_per_layer_tok = (
-        (a4["temp_GB"] - a2["temp_GB"])
-        / ((a4["layers"] - a2["layers"]) * a4["seq"] * a4["batch"])
-    )
-    base = a4["temp_GB"] - k_per_layer_tok * a4["layers"] * a4["seq"]
-    pred_s = base * (a4s["seq"] / a4["seq"]) + (
-        k_per_layer_tok * a4s["layers"] * a4s["seq"]
-    )
-    residual = abs(pred_s - a4s["temp_GB"]) / a4s["temp_GB"]
+    def design(rows):
+        return np.array([
+            [1.0, a["vision_layers"], a["text_layers"],
+             a["text_layers"] * a["seq"] / 1024.0, a["seq"] / 1024.0]
+            for a in rows
+        ])
+
+    fit_rows, held = anchors[:-1], anchors[-1]
+    y = np.array([a["temp_GB"] for a in fit_rows])
+    coef, *_ = np.linalg.lstsq(design(fit_rows), y, rcond=None)
+    pred_held = float(design([held]) @ coef)
+    residual = abs(pred_held - held["temp_GB"]) / held["temp_GB"]
     return {
         "anchors": anchors,
-        "k_GB_per_layer_token": k_per_layer_tok,
-        "base_GB_at_S1024": round(base, 4),
-        "seq_extrapolation_residual": round(residual, 3),
+        "coef": {
+            "c0_GB": round(float(coef[0]), 4),
+            "per_vision_layer_GB": round(float(coef[1]), 4),
+            "per_text_layer_GB": round(float(coef[2]), 4),
+            "per_text_layer_kilotoken_GB": round(float(coef[3]), 5),
+            "per_kilotoken_GB": round(float(coef[4]), 4),
+        },
+        "held_out_pred_GB": round(pred_held, 4),
+        "held_out_measured_GB": held["temp_GB"],
+        "held_out_residual": round(residual, 4),
     }
 
 
@@ -232,22 +261,28 @@ def main() -> None:
     if not args.skip_measure:
         result["measured"] = measured_activation_anchors()
         m, e = result["measured"], result["exact"]
-        # full 11B: 40 text layers (+8 xattn already in the 40-layer stack),
-        # S=8192, per-chip microbatch B=1 (GBS = dp x accum)
-        L_full, S_full, B = 40, 8192, 1
+        # full 11B: 40 vision layers (32 + 8 global), 40 text layers (the 8
+        # xattn layers are inside the 40-layer stack), S=8192, per-chip
+        # microbatch B=1 (GBS = dp x accum); vision remat=full required
+        NV, LT, S_full = 40, 40, 8192
+        c = m["coef"]
         act_full = (
-            m["base_GB_at_S1024"] * (S_full / 1024)
-            + m["k_GB_per_layer_token"] * L_full * S_full * B
+            c["c0_GB"]
+            + c["per_vision_layer_GB"] * NV
+            + c["per_text_layer_GB"] * LT
+            + c["per_text_layer_kilotoken_GB"] * LT * (S_full / 1024)
+            + c["per_kilotoken_GB"] * (S_full / 1024)
         )
+        # honesty margin: scale the estimate by the held-out residual
+        margin = act_full * (1 + m["held_out_residual"])
+        total = e["static_total_GB_per_chip"] + margin
         result["plan_11b"] = {
-            "seq": S_full, "per_chip_microbatch": B,
+            "seq": S_full, "per_chip_microbatch": 1,
+            "vision_remat": "full", "text_remat": "full",
             "activations_GB_per_chip_est": round(act_full, 2),
-            "total_GB_per_chip_est": round(
-                e["static_total_GB_per_chip"] + act_full, 2
-            ),
-            "fits_16GB": bool(
-                e["static_total_GB_per_chip"] + act_full < HBM_PER_CHIP_GB
-            ),
+            "activations_GB_with_residual_margin": round(margin, 2),
+            "total_GB_per_chip_est": round(total, 2),
+            "fits_16GB": bool(total < HBM_PER_CHIP_GB),
         }
     print(json.dumps(result), flush=True)
 

@@ -207,3 +207,36 @@ def test_mllama_loss_and_grads_finite(hf_and_params):
     # cross-attn gates are zero-init: they still receive gradient signal
     g = grads["layers"][1]["cross_attn_attn_gate"]
     assert float(jnp.abs(g).max()) > 0
+
+
+def test_vision_remat_full_matches_none(hf_and_params):
+    """vision remat="full" (the 11B memory-plan requirement,
+    docs/mllama_memory_plan.md) is numerically a no-op: identical loss and
+    gradients, only the backward's recompute schedule changes."""
+    import dataclasses
+
+    _, params = hf_and_params
+    pix, ids, ar_ids, ar_mask, xmask = _inputs()
+
+    def loss_and_grads(cfg):
+        model = MllamaForConditionalGeneration(cfg)
+        return jax.jit(
+            jax.value_and_grad(
+                lambda p: model.loss(
+                    p, jnp.asarray(ids), jnp.asarray(ids), jnp.asarray(pix),
+                    jnp.asarray(ar_ids), jnp.asarray(ar_mask),
+                    jnp.asarray(xmask),
+                )
+            )
+        )(params)
+
+    base_loss, base_grads = loss_and_grads(TINY)
+    remat_cfg = dataclasses.replace(
+        TINY, vision=dataclasses.replace(TINY.vision, remat="full")
+    )
+    remat_loss, remat_grads = loss_and_grads(remat_cfg)
+    np.testing.assert_allclose(float(base_loss), float(remat_loss), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(base_grads), jax.tree.leaves(remat_grads)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
