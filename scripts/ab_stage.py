@@ -35,48 +35,18 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 
-def _sync(tree):
-    import numpy as np
-
-    import jax
-
-    leaf = jax.tree.leaves(tree)[0]
-    np.asarray(jax.device_get(jax.numpy.ravel(leaf)[0]))
-
-
 def time_fn(fn, *args, repeats=6):
-    """Per-call wall time with the host round-trip amortized out.
+    """Shared chained-scan timer (consumes every output leaf so sibling
+    cotangents are never DCE'd) — utils/chipbench.py has the rationale."""
+    from neuronx_distributed_llama3_2_tpu.utils.chipbench import (
+        time_fn as _time_fn,
+    )
 
-    Same pattern as scripts/ring_step_bench.py: chain ``repeats`` calls
-    on-device inside one jitted lax.scan (a scalar of each output feeds the
-    next iteration's first arg so XLA cannot elide the chain), then ONE
-    host sync — a per-iteration device_get would add the ~90 ms dev-chip
-    tunnel RTT to every sample."""
-    import jax
-    import jax.numpy as jnp
-
-    def chained(*a):
-        def body(carry, _):
-            out = fn(carry, *a[1:])
-            first = jax.tree.leaves(out)[0]
-            nudge = jnp.ravel(first)[0].astype(a[0].dtype) * jnp.asarray(
-                1e-12, a[0].dtype
-            )
-            return carry + nudge, None
-
-        carry, _ = jax.lax.scan(body, a[0], None, length=repeats)
-        return carry
-
-    g = jax.jit(chained)
-    _sync(g(*args))  # compile + warmup
-    t0 = time.perf_counter()
-    _sync(g(*args))
-    return (time.perf_counter() - t0) / repeats
+    return _time_fn(fn, *args, repeats=repeats)
 
 
 def head_ab(quick: bool, iters: int) -> dict:

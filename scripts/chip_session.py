@@ -11,12 +11,13 @@ JSON record to the session artifact (``CHIP_SESSION.jsonl``)::
 
     {"stage": ..., "rc": 0, "seconds": 12.3, "parsed": {...}, "tail": "..."}
 
-Stages (see ``STAGES``): relay probe → bench.py (the driver metric) →
-MFU sweep margin → chip-side TTFT 1B/3B → Pallas kernel gate → serving
-churn → 32K long-context gate → head/ring A/B default gates → e2e
-latency report → ring-step timing. If the probe fails the session aborts
-immediately, recording the outage — nothing downstream can succeed
-without a backend.
+Stages (see ``STAGES``, in value-per-chip-minute order): relay probe →
+bench.py (the driver metric) → MFU sweep margin → chip-side TTFT 1B/3B →
+head/ring A/B default gates (early: the provisional defaults are waiting
+on exactly these records) → Pallas kernel gate → serving churn → 32K
+long-context gate → e2e latency report → ring-step timing. If the probe
+fails the session aborts immediately, recording the outage — nothing
+downstream can succeed without a backend.
 
 This module is also the engine behind ``bench.py``'s post-headline
 session (``run_session``): the driver only ever runs ``python bench.py``,
@@ -147,6 +148,7 @@ def run_session(
     stream=None,
     echo_line: "str | None" = None,
     stage_runner=run_stage,
+    reprobe_after_failures: int = 2,
 ):
     """Run ``stages`` (name, argv, timeout) within ``deadline_s``, appending
     one JSON record per stage to ``out_path``.
@@ -156,12 +158,34 @@ def run_session(
     mid-session kill loses only the stage in flight, never completed
     records. ``echo_line`` (the bench headline) is re-printed after every
     record so the stream's last complete JSON line stays the driver metric
-    no matter where a kill lands. Returns ``(results, aborted)``.
+    no matter where a kill lands.
+
+    A relay can die MID-session (the round-2/3/5 outages lasted hours):
+    after ``reprobe_after_failures`` consecutive non-ok stages a bare
+    ``jax.devices()`` probe runs, and if it fails the session aborts —
+    otherwise a dead backend would burn every remaining stage's full
+    timeout banking nothing but failure records. Returns
+    ``(results, aborted)``.
     """
     start = time.monotonic()
     results = []
     aborted = None
+    consecutive_bad = 0
     with open(out_path, "a") as f:
+
+        def emit(rec):
+            results.append(rec)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            if stream is not None:
+                slim = dict(rec)
+                slim["tail"] = slim["tail"][-400:]
+                print(json.dumps(slim), file=stream, flush=True)
+                if echo_line:
+                    print(echo_line, file=stream, flush=True)
+            print(f"[{rec['status']:>7}] {rec['stage']} ({rec['seconds']}s)",
+                  file=sys.stderr, flush=True)
+
         f.write(json.dumps({
             "session_start": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "stages": [s[0] for s in stages],
@@ -173,20 +197,28 @@ def run_session(
                 aborted = f"deadline exhausted before stage {name}"
                 break
             rec = stage_runner(name, argv, min(timeout_s, remaining))
-            results.append(rec)
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            if stream is not None:
-                slim = dict(rec)
-                slim["tail"] = slim["tail"][-400:]
-                print(json.dumps(slim), file=stream, flush=True)
-                if echo_line:
-                    print(echo_line, file=stream, flush=True)
-            print(f"[{rec['status']:>7}] {name} ({rec['seconds']}s)",
-                  file=sys.stderr, flush=True)
+            emit(rec)
             if name == "probe" and rec["status"] != "ok":
                 aborted = f"relay probe {rec['status']} — backend down, aborting"
                 break
+            consecutive_bad = 0 if rec["status"] == "ok" else consecutive_bad + 1
+            if consecutive_bad >= reprobe_after_failures:
+                remaining = deadline_s - (time.monotonic() - start)
+                if remaining <= 30:
+                    aborted = "deadline exhausted at mid-session reprobe"
+                    break
+                probe_rec = stage_runner(
+                    "reprobe", [PY, "-c", PROBE_SNIPPET], min(300, remaining)
+                )
+                emit(probe_rec)
+                if probe_rec["status"] != "ok":
+                    aborted = (
+                        f"relay died mid-session (reprobe "
+                        f"{probe_rec['status']} after {consecutive_bad} "
+                        f"consecutive stage failures) — aborting"
+                    )
+                    break
+                consecutive_bad = 0  # backend is up; failures were stage bugs
         if aborted:
             f.write(json.dumps({"aborted": aborted}) + "\n")
     return results, aborted

@@ -23,49 +23,20 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 
 def time_fn(fn, *args, repeats=8):
-    """Wall time per call with the host round-trip amortized out.
+    """Shared chained-scan timer — utils/chipbench.py. (The earlier local
+    copy consumed only the FIRST output leaf, letting XLA dead-code the
+    dk/dv backward out of the grad timings; the shared helper consumes
+    every leaf.)"""
+    from neuronx_distributed_llama3_2_tpu.utils.chipbench import (
+        time_fn as _time_fn,
+    )
 
-    ``repeats`` calls are chained on-device inside one jitted lax.scan
-    (each iteration's output feeds a data dependency into the next so XLA
-    cannot elide the chain), then ONE host sync — the same pattern as
-    inference.runner.benchmark_prefill_on_device. A per-iteration
-    device_get would add the ~90 ms dev-chip tunnel RTT to every sample
-    and drown the few-ms kernel difference being measured."""
-    import jax.numpy as jnp
-
-    def chained(*a):
-        def body(carry, _):
-            out = fn(carry, *a[1:])
-            # fold a negligible-but-unknown scalar of the output back into
-            # the q carry: a real data dependency XLA cannot constant-fold
-            # away (a literal *0 nudge would be folded and the chain CSE'd)
-            first = jax.tree.leaves(out)[0]
-            nudge = first.reshape(-1)[0].astype(a[0].dtype) * jnp.asarray(
-                1e-12, a[0].dtype
-            )
-            return carry + nudge, None
-
-        carry, _ = jax.lax.scan(body, a[0], None, length=repeats)
-        return carry
-
-    g = jax.jit(chained)
-    _sync(g(*args))  # compile + warmup
-    t0 = time.perf_counter()
-    _sync(g(*args))
-    return (time.perf_counter() - t0) / repeats
-
-
-def _sync(tree):
-    import numpy as np
-
-    leaf = jax.tree.leaves(tree)[0]
-    np.asarray(jax.device_get(leaf.ravel()[0]))
+    return _time_fn(fn, *args, repeats=repeats)
 
 
 def main() -> None:
