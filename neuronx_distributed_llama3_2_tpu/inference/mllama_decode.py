@@ -39,9 +39,20 @@ from neuronx_distributed_llama3_2_tpu.models.mllama import (
     MllamaForConditionalGeneration,
     TextCrossAttention,
     prepare_cross_attention_mask,
+    text_group_pattern,
+    text_layer_slice,
 )
 
 Params = Dict[str, Any]
+
+
+def _layer_at(layers, i: int, t):
+    """Per-layer param tree for absolute layer ``i`` under either text
+    layout (grouped scan stacks or the irregular-pattern list)."""
+    pattern = text_group_pattern(t)
+    if pattern is not None:
+        return text_layer_slice(layers, i, pattern)
+    return layers[i], i in t.cross_attention_layers
 
 
 class MllamaCache(NamedTuple):
@@ -111,9 +122,9 @@ class MllamaDecoder:
         xattn = TextCrossAttention(t)
         ks, vs = [], []
         for i in self.config.text.cross_attention_layers:
-            k, v = xattn.project_kv(
-                params["layers"][i]["cross_attn"], vision_tokens
-            )
+            lp, is_cross = _layer_at(params["layers"], i, t)
+            assert is_cross
+            k, v = xattn.project_kv(lp["cross_attn"], vision_tokens)
             ks.append(k)
             vs.append(v)
         return vision_tokens, ks, vs
@@ -146,7 +157,8 @@ class MllamaDecoder:
         new_v = list(cache.v)
         si = 0  # index into self-layer caches
         ci = 0  # index into cross-layer K/V
-        for i, lp in enumerate(params["layers"]):
+        for i in range(t.num_hidden_layers):
+            lp, _ = _layer_at(params["layers"], i, t)
             if i in t.cross_attention_layers:
                 x = xlayer(
                     lp, x, None, bias, full_row,

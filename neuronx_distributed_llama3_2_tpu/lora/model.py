@@ -80,23 +80,50 @@ def _iter_targets(params: Params, patterns) -> Dict[str, jax.Array]:
     }
 
 
-def _split_shape(shape) -> Tuple[Tuple[int, ...], int, Tuple[int, ...]]:
+# path markers declaring a TWO-leading-stack-dim parameter layout. Today's
+# only registrant is mllama's grouped text stack (models/mllama.py
+# text_group_pattern packs plain layers as (G, k-1, ...)); a future model
+# introducing another grouped layout adds its marker here rather than
+# teaching _split_shape its naming ad hoc.
+TWO_STACK_PATH_MARKERS = ("layers/plain/",)
+
+# plain 2-D kernels a grouped stack lifts to rank 4 — the only rank-4
+# shapes a two-stack split may interpret
+_PLAIN_2D_KERNEL = re.compile(r"(q_kernel|k_kernel|v_kernel|/kernel)$")
+
+
+def _split_shape(shape, path: str = "") -> Tuple[Tuple[int, ...], int, Tuple[int, ...]]:
     """(leading stack dims, in_features, out dims) of a kernel.
 
-    Kernels here are (in, out...) possibly with a leading layer-stack dim:
+    Kernels here are (in, out...) possibly with leading layer-stack dims:
     (in, out) [incl. embeddings, reference LoraEmbedding layer.py:245],
-    (L, in, out), (L, in, t, out) [fused gate_up]. MoE expert weights carry
-    two stack dims (L, E, ...) the single-stack split below would misread —
-    LoraModel refuses expert paths at construction (the reference doesn't
-    LoRA experts either); the rank guard here backstops unknown layouts."""
-    if len(shape) > 4:
+    (L, in, out), (L, in, t, out) [fused gate_up]. Mllama's grouped text
+    layout carries TWO stack dims on the plain-layer stack — (G, k-1, ...)
+    under a ``layers/plain/`` path (models/mllama.py text_group_pattern),
+    identified by path since shape alone is ambiguous with fused gate_up.
+    MoE expert weights also carry two stack dims but in a layout the split
+    would misread — LoraModel refuses expert paths at construction (the
+    reference doesn't LoRA experts either); the rank guard backstops
+    unknown layouts."""
+    n_stack = 2 if any(m in path for m in TWO_STACK_PATH_MARKERS) else 1
+    if len(shape) > 3 + n_stack:
         raise ValueError(
             f"kernel rank {len(shape)} is not LoRA-targetable; exclude it "
             "from target_modules"
         )
     if len(shape) == 2:
         return (), shape[0], (shape[1],)
-    return (shape[0],), shape[1], tuple(shape[2:])
+    if len(shape) == 3 or n_stack == 1:
+        return (shape[0],), shape[1], tuple(shape[2:])
+    if len(shape) == 4 and not _PLAIN_2D_KERNEL.search(path):
+        # a rank-4 leaf under a grouped stack that is NOT a plain 2-D
+        # kernel is shape-ambiguous (could be a single-stack fused
+        # (L, in, t, out)) — refuse loudly rather than mis-split
+        raise ValueError(
+            f"ambiguous rank-4 kernel under a grouped stack: {path} "
+            f"{tuple(shape)}; exclude it from target_modules"
+        )
+    return tuple(shape[:2]), shape[2], tuple(shape[3:])
 
 
 class LoraModel:
@@ -156,7 +183,7 @@ class LoraModel:
         n = len(self._targets) + len(self._conv_targets)
         keys = jax.random.split(key, n)
         for k, (path, leaf) in zip(keys, sorted(self._targets.items())):
-            stack, fan_in, out_dims = _split_shape(leaf.shape)
+            stack, fan_in, out_dims = _split_shape(leaf.shape, path)
             dt = cfg.dtype or leaf.dtype
             a = (
                 jax.random.normal(k, (*stack, fan_in, cfg.r), jnp.float32)
@@ -190,7 +217,7 @@ class LoraModel:
         for path, spec in base_specs.items():
             parts = list(spec)
             shape = self._targets[path].shape
-            nstack = 1 if len(shape) > 2 else 0
+            nstack = len(_split_shape(shape, path)[0])
             parts = parts + [None] * (len(shape) - len(parts))
             stack_p = parts[:nstack]
             in_p = parts[nstack]
@@ -238,11 +265,17 @@ class LoraModel:
             if key in flat_targets and key in adapters:
                 ab = adapters[key]
                 a, b = ab["a"], ab["b"]
-                stack, fan_in, out_dims = _split_shape(leaf.shape)
+                stack, fan_in, out_dims = _split_shape(leaf.shape, key)
                 if stack:
-                    delta = jnp.einsum(
-                        "lir,lr...->li...", a.astype(jnp.float32),
-                        b.astype(jnp.float32),
+                    # arbitrary leading stack dims (1 for stacked layers,
+                    # 2 for mllama's grouped plain stack): flatten, apply
+                    # the single-stack contraction, restore
+                    a2 = a.astype(jnp.float32).reshape((-1, fan_in, a.shape[-1]))
+                    b2 = b.astype(jnp.float32).reshape(
+                        (-1, b.shape[len(stack)]) + tuple(out_dims)
+                    )
+                    delta = jnp.einsum("lir,lr...->li...", a2, b2).reshape(
+                        tuple(stack) + (fan_in,) + tuple(out_dims)
                     )
                 else:
                     delta = jnp.einsum(

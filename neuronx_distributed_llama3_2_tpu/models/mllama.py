@@ -43,6 +43,12 @@ from neuronx_distributed_llama3_2_tpu.models.llama import (
     RMSNorm,
     precompute_rope,
 )
+from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
+from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+    BATCH_AXES,
+    constrain,
+)
+from neuronx_distributed_llama3_2_tpu.parallel.state import TP_AXIS
 from neuronx_distributed_llama3_2_tpu.parallel.conv import (
     OutputChannelParallelConv2d,
 )
@@ -304,6 +310,54 @@ class VisionMLP:
         return self._fc2()(params["fc2"], h)
 
 
+def _stack_trees(trees):
+    """Per-layer param dicts → stacked (L, ...) leaves (scan layout)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def text_group_pattern(t: "MllamaTextConfig"):
+    """(G, k, xpos) when the cross-attention layers form the regular
+    pattern ``xpos + g*k`` (true of every HF Mllama config: 11B has
+    stride-5 groups at offset 3). None for irregular configs, which fall
+    back to the per-layer list layout + Python loop."""
+    xl = tuple(t.cross_attention_layers)
+    G = len(xl)
+    if G == 0 or t.num_hidden_layers % G:
+        return None
+    k = t.num_hidden_layers // G
+    # k == 1 means EVERY layer is cross-attention: a group would hold zero
+    # plain layers (empty stack) — use the list layout instead
+    if k < 2:
+        return None
+    xpos = xl[0]
+    if xpos >= k or xl != tuple(xpos + g * k for g in range(G)):
+        return None
+    return G, k, xpos
+
+
+def _pack_text_layers(layer_list, pattern):
+    """Per-layer trees → grouped scan layout {"plain": (G, k-1, ...),
+    "xattn": (G, ...)} following ``text_group_pattern``."""
+    G, k, xpos = pattern
+    plains, xatts = [], []
+    for g in range(G):
+        grp = layer_list[g * k:(g + 1) * k]
+        xatts.append(grp[xpos])
+        plains.append(_stack_trees([grp[j] for j in range(k) if j != xpos]))
+    return {"plain": _stack_trees(plains), "xattn": _stack_trees(xatts)}
+
+
+def text_layer_slice(layers, i: int, pattern):
+    """(per-layer tree, is_cross) for absolute layer ``i`` of the grouped
+    layout — the accessor the decode path uses (static python index)."""
+    G, k, xpos = pattern
+    g, j = divmod(i, k)
+    if j == xpos:
+        return jax.tree.map(lambda x: x[g], layers["xattn"]), True
+    p = j if j < xpos else j - 1
+    return jax.tree.map(lambda x: x[g, p], layers["plain"]), False
+
+
 @dataclasses.dataclass(frozen=True)
 class VisionEncoderLayer:
     """Pre-LN ViT block; global layers tanh-gate both residual branches
@@ -417,16 +471,24 @@ class MllamaVisionModel:
             },
             "layernorm_pre": LayerNorm(c.hidden_size, dtype=c.dtype).init(keys[6]),
             "layernorm_post": LayerNorm(c.hidden_size, dtype=c.dtype).init(keys[7]),
-            "transformer": [
-                VisionEncoderLayer(c, is_gated=False).init(keys[8 + i])
-                for i in range(c.num_hidden_layers)
-            ],
-            "global_transformer": [
-                VisionEncoderLayer(c, is_gated=True).init(
-                    keys[8 + c.num_hidden_layers + i]
-                )
-                for i in range(c.num_global_layers)
-            ],
+            # both stacks are internally homogeneous → stacked (L, ...)
+            # leaves scanned like the text stack (the Python layer loop
+            # carried 0.337 GB/layer of unreusable temp under remat —
+            # docs/mllama_memory_plan.md)
+            "transformer": _stack_trees(
+                [
+                    VisionEncoderLayer(c, is_gated=False).init(keys[8 + i])
+                    for i in range(c.num_hidden_layers)
+                ]
+            ),
+            "global_transformer": _stack_trees(
+                [
+                    VisionEncoderLayer(c, is_gated=True).init(
+                        keys[8 + c.num_hidden_layers + i]
+                    )
+                    for i in range(c.num_global_layers)
+                ]
+            ),
         }
         return p
 
@@ -445,14 +507,18 @@ class MllamaVisionModel:
             "post_tile_positional_embedding": dict(rep2),
             "layernorm_pre": LayerNorm(c.hidden_size).specs(),
             "layernorm_post": LayerNorm(c.hidden_size).specs(),
-            "transformer": [
-                VisionEncoderLayer(c, is_gated=False).specs()
-                for _ in range(c.num_hidden_layers)
-            ],
-            "global_transformer": [
-                VisionEncoderLayer(c, is_gated=True).specs()
-                for _ in range(c.num_global_layers)
-            ],
+            # stacked (L, ...) leaves: replicate the stack dim, keep each
+            # layer's tp sharding on the trailing dims
+            "transformer": jax.tree.map(
+                lambda s: P(None, *s),
+                VisionEncoderLayer(c, is_gated=False).specs(),
+                is_leaf=lambda s: isinstance(s, P),
+            ),
+            "global_transformer": jax.tree.map(
+                lambda s: P(None, *s),
+                VisionEncoderLayer(c, is_gated=True).specs(),
+                is_leaf=lambda s: isinstance(s, P),
+            ),
         }
 
     def _tile_embedding(self, emb_params, hidden, aspect_ratio_ids):
@@ -537,28 +603,43 @@ class MllamaVisionModel:
 
         hidden = hidden.reshape(b * m, t * tlen, c.hidden_size)
 
-        # per-layer remat: differentiated operands (layer params, hidden,
-        # bias) enter as explicit jax.checkpoint arguments (same rule as
-        # the text side, line ~843)
+        # scanned stacked layers (like the text stack): one layer's working
+        # set is reused across all L iterations, and per-iteration
+        # jax.checkpoint bounds the backward at one layer's recompute +
+        # the (L, BM, S, H) boundary stash. Intermediate hidden states are
+        # collected into K one-hot-masked carry slots (a data-dependent
+        # append does not exist under scan). bias/sin-style loop constants
+        # ride the closure, same as the text side's _scan_stage.
         from neuronx_distributed_llama3_2_tpu.models.llama import _remat_policy
 
         policy = _remat_policy(c.remat)
+        inter_idx = jnp.asarray(c.intermediate_layers_indices, jnp.int32)
+        K = len(c.intermediate_layers_indices)
 
-        def plain_body(lp, h, bias):
-            return VisionEncoderLayer(c, is_gated=False)(lp, h, bias)
+        def plain_body(carry, xs):
+            h, inter = carry
+            lp, i = xs
+            h = VisionEncoderLayer(c, is_gated=False)(lp, h, bias)
+            keep = (inter_idx == i).astype(inter.dtype)[:, None, None, None]
+            inter = inter * (1 - keep) + h[None].astype(inter.dtype) * keep
+            return (h, inter), None
 
-        def gated_body(lp, h, bias):
-            return VisionEncoderLayer(c, is_gated=True)(lp, h, bias)
+        def gated_body(h, lp):
+            return VisionEncoderLayer(c, is_gated=True)(lp, h, bias), None
 
         if policy is not None:
             plain_body = jax.checkpoint(plain_body, policy=policy)
             gated_body = jax.checkpoint(gated_body, policy=policy)
 
-        intermediates: List[jax.Array] = []
-        for i, lp in enumerate(params["transformer"]):
-            hidden = plain_body(lp, hidden, bias)
-            if i in c.intermediate_layers_indices:
-                intermediates.append(hidden)
+        inter0 = jnp.zeros((K,) + hidden.shape, hidden.dtype)
+        (hidden, inter_stack), _ = jax.lax.scan(
+            plain_body,
+            (hidden, inter0),
+            (
+                params["transformer"],
+                jnp.arange(c.num_hidden_layers, dtype=jnp.int32),
+            ),
+        )
 
         hidden = LayerNorm(c.hidden_size, c.norm_eps, c.dtype)(
             params["layernorm_post"], hidden
@@ -568,12 +649,13 @@ class MllamaVisionModel:
             params["post_tile_positional_embedding"], hidden, ar_ids
         )
         hidden = hidden.reshape(b * m, t * tlen, c.hidden_size)
-        for lp in params["global_transformer"]:
-            hidden = gated_body(lp, hidden, bias)
+        hidden, _ = jax.lax.scan(
+            gated_body, hidden, params["global_transformer"]
+        )
 
         # strip padding, collect (final, intermediates)
         hidden = hidden.reshape(b * m, t, tlen, c.hidden_size)[:, :, :n_pat]
-        inter = jnp.stack(intermediates, axis=-1)  # (BM, S, H, K)
+        inter = jnp.moveaxis(inter_stack, 0, -1)  # (BM, S, H, K)
         inter = inter.reshape(b * m, t, tlen, -1)[:, :, :n_pat]
         out = jnp.concatenate(
             [hidden.reshape(b * m, t, n_pat, c.hidden_size), inter], axis=-1
@@ -717,7 +799,13 @@ class CrossAttentionDecoderLayer:
             bias,
             kv=kv,
         )
-        x = x + jnp.tanh(params["cross_attn_attn_gate"]) * h
+        # gates stay fp32 (zero-init trainability); the gated residual is
+        # computed in fp32 then cast back so a bf16 stream STAYS bf16 —
+        # the old promotion silently upcast every layer after the first
+        # cross-attn block (and broke the grouped scan's fixed carry type)
+        x = x + (
+            jnp.tanh(params["cross_attn_attn_gate"]) * h.astype(jnp.float32)
+        ).astype(x.dtype)
         h = LlamaMLP(self._mlp_cfg())(
             params["mlp"], self._norm()(params["post_attention_layernorm"], x)
         )
@@ -725,7 +813,9 @@ class CrossAttentionDecoderLayer:
             # (B, 1, S, 1) head-broadcast mask → (B, S, 1) for the hidden
             # stream (HF applies [:, 0], modeling_mllama.py:720)
             h = full_row_mask[:, 0] * h
-        return x + jnp.tanh(params["cross_attn_mlp_gate"]) * h
+        return x + (
+            jnp.tanh(params["cross_attn_mlp_gate"]) * h.astype(jnp.float32)
+        ).astype(x.dtype)
 
 
 def prepare_cross_attention_mask(
@@ -786,6 +876,10 @@ class MllamaForConditionalGeneration:
                 layers.append(CrossAttentionDecoderLayer(t).init(keys[i]))
             else:
                 layers.append(self._self_layer().init(keys[i]))
+        pattern = text_group_pattern(t)
+        if pattern is not None:
+            # grouped scan layout: one group's program, G-fold buffer reuse
+            layers = _pack_text_layers(layers, pattern)
         return {
             "vision_model": MllamaVisionModel(self.config.vision).init(keys[-5]),
             "multi_modal_projector": self._projector().init(keys[-4]),
@@ -797,12 +891,29 @@ class MllamaForConditionalGeneration:
 
     def specs(self) -> Params:
         t = self.config.text
-        layers = []
-        for i in range(t.num_hidden_layers):
-            if i in t.cross_attention_layers:
-                layers.append(CrossAttentionDecoderLayer(t).specs())
-            else:
-                layers.append(self._self_layer().specs())
+        pattern = text_group_pattern(t)
+        if pattern is not None:
+            is_p = lambda s: isinstance(s, P)  # noqa: E731
+            layers = {
+                # (G, k-1, ...) / (G, ...): replicate the stack dims
+                "plain": jax.tree.map(
+                    lambda s: P(None, None, *s),
+                    self._self_layer().specs(),
+                    is_leaf=is_p,
+                ),
+                "xattn": jax.tree.map(
+                    lambda s: P(None, *s),
+                    CrossAttentionDecoderLayer(t).specs(),
+                    is_leaf=is_p,
+                ),
+            }
+        else:
+            layers = []
+            for i in range(t.num_hidden_layers):
+                if i in t.cross_attention_layers:
+                    layers.append(CrossAttentionDecoderLayer(t).specs())
+                else:
+                    layers.append(self._self_layer().specs())
         return {
             "vision_model": MllamaVisionModel(self.config.vision).specs(),
             "multi_modal_projector": self._projector().specs(),
@@ -859,6 +970,16 @@ class MllamaForConditionalGeneration:
         x = self._embed()(params["embed"], input_ids)
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         sin, cos = precompute_rope(t.head_dim, s, t.rope_theta, t.rope_scaling)
+        sp = parallel_state.sequence_parallel_enabled()
+        if sp:
+            # Megatron SP over the text stream (same GSPMD formulation as
+            # llama._backbone): shard seq over tp between blocks, so every
+            # (B, S, H) activation — incl. the remat stash that dominates
+            # the 11B memory plan's Lt·S term — carries S/tp per chip. The
+            # self layers adapt via the parallel-state flag; cross-attn
+            # q/o projections gather/reduce-scatter at their boundaries
+            # under the same constraint.
+            x = constrain(x, P(BATCH_AXES, TP_AXIS, None))
         layer = self._self_layer()
         xlayer = CrossAttentionDecoderLayer(t)
 
@@ -876,14 +997,44 @@ class MllamaForConditionalGeneration:
         if policy is not None:
             self_body = jax.checkpoint(self_body, policy=policy)
             xattn_body = jax.checkpoint(xattn_body, policy=policy)
-        for i, lp in enumerate(params["layers"]):
-            if i in t.cross_attention_layers:
-                x = xattn_body(lp, x, vision_tokens)
-            else:
-                x = self_body(lp, x)
-        return RMSNorm(t.hidden_size, t.rms_norm_eps, t.dtype)(
+        pattern = text_group_pattern(t)
+        if pattern is not None:
+            # grouped scan (program = ONE group of k layers; buffers reused
+            # across the G groups — the Python loop carried ~0.17 GB/layer
+            # of unreusable temp, docs/mllama_memory_plan.md)
+            _, k, xpos = pattern
+
+            def group_body(x, xs):
+                plains, xat = xs
+                p = 0
+                for j in range(k):
+                    if j == xpos:
+                        x = xattn_body(xat, x, vision_tokens)
+                    else:
+                        lp = jax.tree.map(lambda a, _p=p: a[_p], plains)
+                        x = self_body(lp, x)
+                        p += 1
+                return x, None
+
+            x, _ = jax.lax.scan(
+                group_body,
+                x,
+                (params["layers"]["plain"], params["layers"]["xattn"]),
+            )
+        else:
+            for i, lp in enumerate(params["layers"]):
+                if i in t.cross_attention_layers:
+                    x = xattn_body(lp, x, vision_tokens)
+                else:
+                    x = self_body(lp, x)
+        x = RMSNorm(t.hidden_size, t.rms_norm_eps, t.dtype)(
             params["final_norm"], x
         )
+        if sp:
+            # exit SP before the loss/lm-head consumers (reference
+            # gather_from_sequence_parallel_region, modeling_llama_nxd.py:625)
+            x = constrain(x, P(BATCH_AXES, None, None))
+        return x
 
     def loss(
         self,
@@ -1000,14 +1151,18 @@ def mllama_params_from_hf(state_dict: Dict[str, Any], config: MllamaConfig) -> P
         },
         "layernorm_pre": ln(vp + "layernorm_pre"),
         "layernorm_post": ln(vp + "layernorm_post"),
-        "transformer": [
-            vis_layer(f"{vp}transformer.layers.{i}.")
-            for i in range(c.num_hidden_layers)
-        ],
-        "global_transformer": [
-            vis_layer(f"{vp}global_transformer.layers.{i}.")
-            for i in range(c.num_global_layers)
-        ],
+        "transformer": _stack_trees(
+            [
+                vis_layer(f"{vp}transformer.layers.{i}.")
+                for i in range(c.num_hidden_layers)
+            ]
+        ),
+        "global_transformer": _stack_trees(
+            [
+                vis_layer(f"{vp}global_transformer.layers.{i}.")
+                for i in range(c.num_global_layers)
+            ]
+        ),
     }
 
     tp_ = "model.language_model."
@@ -1054,6 +1209,9 @@ def mllama_params_from_hf(state_dict: Dict[str, Any], config: MllamaConfig) -> P
                 }
             )
 
+    pattern = text_group_pattern(tc)
+    if pattern is not None:
+        layers = _pack_text_layers(layers, pattern)
     return {
         "vision_model": vision,
         "multi_modal_projector": lin_b("model.multi_modal_projector"),
@@ -1134,10 +1292,20 @@ def mllama_params_to_hf(params: Params, config: MllamaConfig) -> Dict[str, Any]:
             sd[prefix + "gate_attn"] = np32(p["gate_attn"]).reshape(1)
             sd[prefix + "gate_ffn"] = np32(p["gate_ffn"]).reshape(1)
 
-    for i, p in enumerate(vis["transformer"]):
-        put_vis_layer(f"{vp}transformer.layers.{i}.", p, gated=False)
-    for i, p in enumerate(vis["global_transformer"]):
-        put_vis_layer(f"{vp}global_transformer.layers.{i}.", p, gated=True)
+    n_plain = jax.tree.leaves(vis["transformer"])[0].shape[0]
+    for i in range(n_plain):
+        put_vis_layer(
+            f"{vp}transformer.layers.{i}.",
+            jax.tree.map(lambda x: x[i], vis["transformer"]),
+            gated=False,
+        )
+    n_global = jax.tree.leaves(vis["global_transformer"])[0].shape[0]
+    for i in range(n_global):
+        put_vis_layer(
+            f"{vp}global_transformer.layers.{i}.",
+            jax.tree.map(lambda x: x[i], vis["global_transformer"]),
+            gated=True,
+        )
 
     def put_mlp(pre, mlp):
         gate_up = np32(mlp["gate_up"])  # (H, 2, I)
@@ -1147,7 +1315,15 @@ def mllama_params_to_hf(params: Params, config: MllamaConfig) -> Dict[str, Any]:
 
     tp_ = "model.language_model."
     tc = config.text
-    for i, p in enumerate(params["layers"]):
+    pattern = text_group_pattern(tc)
+    if pattern is not None:
+        text_layers = [
+            text_layer_slice(params["layers"], i, pattern)[0]
+            for i in range(tc.num_hidden_layers)
+        ]
+    else:
+        text_layers = params["layers"]
+    for i, p in enumerate(text_layers):
         pre = f"{tp_}layers.{i}."
         if i in tc.cross_attention_layers:
             put_ln(pre + "input_layernorm", p["input_layernorm"])

@@ -195,7 +195,9 @@ def measured_activation_anchors():
     from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
 
     parallel_state.destroy_model_parallel()
-    parallel_state.initialize_model_parallel(tensor_model_parallel_size=8)
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=8, sequence_parallel=True
+    )
 
     # (nv_plain, nv_global, lt, seq); the last row is held out of the fit
     grid = [

@@ -245,7 +245,7 @@ def test_conv_target_vision_lora_parity():
     cfg = LoraConfig(
         r=4,
         alpha=8.0,
-        target_modules=(r"layers/0/attn/qkv/q_kernel$",),
+        target_modules=(r"layers/plain/attn/qkv/q_kernel$",),
         conv_target_modules=(r"vision_model/patch_embedding/kernel$",),
     )
     lm = LoraModel(model, params, cfg)

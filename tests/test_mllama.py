@@ -205,8 +205,16 @@ def test_mllama_loss_and_grads_finite(hf_and_params):
     assert np.isfinite(float(loss))
     assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
     # cross-attn gates are zero-init: they still receive gradient signal
-    g = grads["layers"][1]["cross_attn_attn_gate"]
-    assert float(jnp.abs(g).max()) > 0
+    from neuronx_distributed_llama3_2_tpu.models.mllama import (
+        text_group_pattern,
+        text_layer_slice,
+    )
+
+    lp, is_cross = text_layer_slice(
+        grads["layers"], 1, text_group_pattern(TINY.text)
+    )
+    assert is_cross
+    assert float(jnp.abs(lp["cross_attn_attn_gate"]).max()) > 0
 
 
 def test_vision_remat_full_matches_none(hf_and_params):
@@ -240,3 +248,82 @@ def test_vision_remat_full_matches_none(hf_and_params):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
         )
+
+
+def test_text_group_pattern_regular_and_irregular():
+    """The grouped scan layout engages exactly when the cross-attn layers
+    form the HF-regular xpos + g*k pattern (11B: stride 5, offset 3); an
+    irregular config falls back to the per-layer list."""
+    import dataclasses
+
+    from neuronx_distributed_llama3_2_tpu.models.mllama import (
+        MLLAMA_CONFIGS,
+        text_group_pattern,
+    )
+
+    big = MLLAMA_CONFIGS["llama3.2-11b-vision"].text
+    assert text_group_pattern(big) == (8, 5, 3)
+    assert text_group_pattern(TINY.text) == (2, 2, 1)
+    irregular = dataclasses.replace(big, cross_attention_layers=(3, 8, 14))
+    assert text_group_pattern(irregular) is None
+    # irregular configs still construct + run (the list/loop fallback)
+    irr_tiny = dataclasses.replace(
+        TINY, text=dataclasses.replace(
+            TINY.text, cross_attention_layers=(1, 2)
+        )
+    )
+    model = MllamaForConditionalGeneration(irr_tiny)
+    params = model.init(jax.random.key(0))
+    assert isinstance(params["layers"], list)
+    pix, ids, ar_ids, ar_mask, xmask = _inputs()
+    logits = jax.jit(
+        lambda p: model(
+            p, jnp.asarray(ids), jnp.asarray(pix), jnp.asarray(ar_ids),
+            jnp.asarray(ar_mask), jnp.asarray(xmask),
+        )
+    )(params)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_mllama_under_tp_sequence_parallel(hf_and_params):
+    """tp=4 + Megatron SP over the text stream matches the unsharded
+    logits — the sharding layout the 11B memory plan depends on
+    (docs/mllama_memory_plan.md: the Lt·S activation term divides by tp)."""
+    _, params = hf_and_params
+    pix, ids, ar_ids, ar_mask, xmask = _inputs()
+    model = MllamaForConditionalGeneration(TINY)
+    ref = jax.jit(model.__call__)(
+        params, jnp.asarray(ids), jnp.asarray(pix), jnp.asarray(ar_ids),
+        jnp.asarray(ar_mask), jnp.asarray(xmask),
+    )
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=4, sequence_parallel=True
+    )
+    sharded = shard_pytree(params, model.specs())
+    out = jax.jit(model.__call__)(
+        sharded, jnp.asarray(ids), jnp.asarray(pix), jnp.asarray(ar_ids),
+        jnp.asarray(ar_mask), jnp.asarray(xmask),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4, rtol=1e-3
+    )
+
+
+def test_text_group_pattern_rejects_all_cross_layers():
+    """k=1 (every layer cross-attn) would pack an EMPTY plain stack — the
+    pattern must reject it so init falls back to the list layout instead
+    of crashing in _stack_trees([])."""
+    import dataclasses
+
+    from neuronx_distributed_llama3_2_tpu.models.mllama import (
+        text_group_pattern,
+    )
+
+    all_cross = dataclasses.replace(
+        TINY.text, num_hidden_layers=2, cross_attention_layers=(0, 1)
+    )
+    assert text_group_pattern(all_cross) is None
+    cfg = dataclasses.replace(TINY, text=all_cross)
+    model = MllamaForConditionalGeneration(cfg)
+    params = model.init(jax.random.key(0))
+    assert isinstance(params["layers"], list) and len(params["layers"]) == 2
