@@ -849,10 +849,23 @@ class MllamaForConditionalGeneration:
     def _self_layer(self) -> LlamaDecoderLayer:
         return LlamaDecoderLayer(self.config.text.self_attn_layer_config())
 
+    @staticmethod
+    def _tp() -> int:
+        return parallel_state.tensor_parallel_size_or(1)
+
     def _embed(self) -> ParallelEmbedding:
         t = self.config.text
-        # +8 special tokens (HF reserves extra rows for the image token etc.)
-        return ParallelEmbedding(t.vocab_size + 8, t.hidden_size, dtype=t.dtype)
+        rows = t.vocab_size + 8
+        # +8 special tokens (HF reserves extra rows for the image token
+        # etc.) make rows ≡ 8 (mod 16), so at tp=16 — the 11B fitting
+        # config, docs/mllama_memory_plan.md — the EMBEDDING (alone; the
+        # +8-free LM head still divides) falls back to embedding-dim
+        # sharding: H=4096 divides any practical tp, GSPMD keeps the math
+        # identical.
+        return ParallelEmbedding(
+            rows, t.hidden_size, dtype=t.dtype,
+            shard_dim="vocab" if rows % self._tp() == 0 else "embed",
+        )
 
     def _projector(self) -> ColumnParallelLinear:
         return ColumnParallelLinear(
@@ -863,9 +876,19 @@ class MllamaForConditionalGeneration:
             dtype=self.config.text.dtype,
         )
 
-    def _lm_head(self) -> ColumnParallelLinear:
+    def _lm_head(self):
         t = self.config.text
-        return ColumnParallelLinear(t.hidden_size, t.vocab_size, dtype=t.dtype)
+        if t.vocab_size % self._tp() == 0:
+            return ColumnParallelLinear(
+                t.hidden_size, t.vocab_size, dtype=t.dtype
+            )
+        # vocab-indivisible tp (NOT the tp=16 case — 128256 % 16 == 0, so
+        # the 11B head stays ColumnParallel there; this covers odd vocabs
+        # / tp choices generally): shard the head on its INPUT dim — same
+        # {"kernel": (H, V)} param tree, XLA all-reduces the partial
+        # logits; parallel_cross_entropy takes its plain-CE branch on the
+        # replicated logits
+        return RowParallelLinear(t.hidden_size, t.vocab_size, dtype=t.dtype)
 
     def init(self, key) -> Params:
         t = self.config.text

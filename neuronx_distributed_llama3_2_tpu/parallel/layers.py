@@ -260,9 +260,7 @@ class GQAQKVColumnParallelLinear:
     def _tp(self) -> int:
         if self.tensor_parallel_size is not None:
             return self.tensor_parallel_size
-        if parallel_state.model_parallel_is_initialized():
-            return parallel_state.get_tensor_model_parallel_size()
-        return 1
+        return parallel_state.tensor_parallel_size_or(1)
 
     def _kv_sharded(self) -> bool:
         return self.num_kv_heads % self._tp() == 0

@@ -85,6 +85,11 @@ def parallel_cross_entropy(
     if (
         not parallel_state.model_parallel_is_initialized()
         or parallel_state.get_tensor_model_parallel_size() == 1
+        # vocab-indivisible tp (the Row-parallel LM-head fallback for odd
+        # vocab/tp combinations): logits arrive replicated over tp — the
+        # vocab-sharded shard_map cannot split them; plain CE is exact
+        or logits.shape[-1] % parallel_state.get_tensor_model_parallel_size()
+        != 0
     ):
         return cross_entropy(logits, labels, label_smoothing)
 

@@ -265,6 +265,18 @@ def get_tensor_model_parallel_size() -> int:
     return get_parallel_state().tensor_parallel_size
 
 
+def tensor_parallel_size_or(default: int = 1) -> int:
+    """tp size if parallel state is live, else ``default`` — the shared
+    "layer built before/without a mesh" rule (used by the GQA QKV layer
+    and the mllama embed/head sharding decisions; one definition so they
+    can never diverge)."""
+    return (
+        get_tensor_model_parallel_size()
+        if model_parallel_is_initialized()
+        else default
+    )
+
+
 def get_pipeline_model_parallel_size() -> int:
     return get_parallel_state().pipeline_parallel_size
 

@@ -327,3 +327,82 @@ def test_text_group_pattern_rejects_all_cross_layers():
     model = MllamaForConditionalGeneration(cfg)
     params = model.init(jax.random.key(0))
     assert isinstance(params["layers"], list) and len(params["layers"]) == 2
+
+
+def test_mllama_tp_with_indivisible_vocab(hf_and_params):
+    """When tp doesn't divide the vocab (tp=16 with the 128256+8-row
+    embedding — the 11B fitting config's blocker), the embed falls back to
+    embedding-dim sharding and the head to input-dim sharding; logits must
+    match the unsharded model exactly. Simulated here with a vocab that
+    tp=8 does not divide."""
+    import dataclasses
+
+    _, params = hf_and_params
+    pix, ids, ar_ids, ar_mask, xmask = _inputs()
+
+    # TINY vocab 128: divisible by 8. Test the fallback decision logic on
+    # a config whose vocab is NOT: trim both tables to vocab 124.
+    cfg = dataclasses.replace(
+        TINY, text=dataclasses.replace(TINY.text, vocab_size=124)
+    )
+    model = MllamaForConditionalGeneration(cfg)
+    p124 = dict(params)
+    p124["embed"] = {"embedding": params["embed"]["embedding"][: 124 + 8]}
+    p124["lm_head"] = {"kernel": params["lm_head"]["kernel"][:, :124]}
+    ids124 = np.minimum(ids, 123)
+
+    ref = jax.jit(model.__call__)(
+        p124, jnp.asarray(ids124), jnp.asarray(pix), jnp.asarray(ar_ids),
+        jnp.asarray(ar_mask), jnp.asarray(xmask),
+    )
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=8, sequence_parallel=True
+    )
+    specs = model.specs()
+    from jax.sharding import PartitionSpec as _P
+
+    # embed rows 132 % 8 != 0 -> embed-dim sharding; vocab 124 % 8 != 0 ->
+    # input-dim (Row-parallel) head
+    assert specs["embed"]["embedding"] == _P(None, "tp")
+    assert specs["lm_head"]["kernel"] == _P("tp", None)
+    sharded = shard_pytree(p124, specs)
+    out = jax.jit(model.__call__)(
+        sharded, jnp.asarray(ids124), jnp.asarray(pix), jnp.asarray(ar_ids),
+        jnp.asarray(ar_mask), jnp.asarray(xmask),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4, rtol=1e-3
+    )
+
+
+def test_mllama_loss_with_indivisible_vocab(hf_and_params):
+    """The CE path under the Row-parallel head fallback: logits arrive
+    replicated over tp, so parallel_cross_entropy must take the plain-CE
+    branch rather than the vocab-sharded shard_map (which cannot split an
+    indivisible vocab). Loss must match the unsharded model."""
+    import dataclasses
+
+    _, params = hf_and_params
+    pix, ids, ar_ids, ar_mask, xmask = _inputs()
+    cfg = dataclasses.replace(
+        TINY, text=dataclasses.replace(TINY.text, vocab_size=124)
+    )
+    model = MllamaForConditionalGeneration(cfg)
+    p124 = dict(params)
+    p124["embed"] = {"embedding": params["embed"]["embedding"][: 124 + 8]}
+    p124["lm_head"] = {"kernel": params["lm_head"]["kernel"][:, :124]}
+    ids124 = jnp.asarray(np.minimum(ids, 123))
+
+    def loss_of(p):
+        return model.loss(
+            p, ids124, ids124, jnp.asarray(pix), jnp.asarray(ar_ids),
+            jnp.asarray(ar_mask), jnp.asarray(xmask),
+        )
+
+    ref = float(jax.jit(loss_of)(p124))
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=8, sequence_parallel=True
+    )
+    sharded = shard_pytree(p124, model.specs())
+    got = float(jax.jit(loss_of)(sharded))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
