@@ -113,6 +113,14 @@ def _split_shape(shape, path: str = "") -> Tuple[Tuple[int, ...], int, Tuple[int
         )
     if len(shape) == 2:
         return (), shape[0], (shape[1],)
+    if n_stack == 2 and len(shape) == 3:
+        # a rank-3 leaf under a grouped stack is a stacked VECTOR
+        # (G, k-1, dim) — e.g. a norm scale — not a kernel; the
+        # single-stack split would silently read fan_in = k-1
+        raise ValueError(
+            f"rank-3 leaf under a grouped stack is not LoRA-targetable: "
+            f"{path} {tuple(shape)}; exclude it from target_modules"
+        )
     if len(shape) == 3 or n_stack == 1:
         return (shape[0],), shape[1], tuple(shape[2:])
     if len(shape) == 4 and not _PLAIN_2D_KERNEL.search(path):

@@ -71,6 +71,18 @@ def compute_plan(
     assert n_dev == tp * pp, (n_dev, tp * pp)
     n_proc = n_dev // devices_per_process
     pos = {d: i for i, d in enumerate(mesh.devices.flat)}
+    multi_process = jax.process_count() > 1
+
+    def proc_of(dev) -> int:
+        # real multi-host: the device KNOWS its process — mesh order may be
+        # permuted by create_device_mesh's ICI-topology reordering, so
+        # positional attribution would mislabel hosts. The positional model
+        # is the single-process SIMULATION only (where all devices report
+        # process 0), and assumes the contiguous plain-reshape device order
+        # of the simulated mesh.
+        if multi_process:
+            return dev.process_index
+        return pos[dev] // devices_per_process
 
     model = PipelinedCausalLM(
         LlamaForCausalLM(LLAMA_CONFIGS[model_name]),
@@ -112,7 +124,7 @@ def compute_plan(
                 nbytes = itemsize * float(
                     np.prod([b - a for a, b in norm]) if norm else 1
                 )
-                proc = pos[dev] // devices_per_process
+                proc = proc_of(dev)
                 per_proc[proc] += nbytes
                 leaf_procs.add(proc)
                 leaf_bytes += nbytes
