@@ -604,25 +604,20 @@ class MllamaVisionModel:
         hidden = hidden.reshape(b * m, t * tlen, c.hidden_size)
 
         # scanned stacked layers (like the text stack): one layer's working
-        # set is reused across all L iterations, and per-iteration
-        # jax.checkpoint bounds the backward at one layer's recompute +
-        # the (L, BM, S, H) boundary stash. Intermediate hidden states are
-        # collected into K one-hot-masked carry slots (a data-dependent
-        # append does not exist under scan). bias/sin-style loop constants
-        # ride the closure, same as the text side's _scan_stage.
+        # set is reused across iterations, and per-iteration jax.checkpoint
+        # bounds the backward at one layer's recompute + the (BM, S, H)
+        # boundary stash per layer. The static intermediate_layers_indices
+        # split the stack into K+1 statically-sliced scan SEGMENTS with the
+        # hidden state collected at each boundary — carrying a (K, BM, S,
+        # H) slot buffer through one scan would multiply every boundary
+        # stash by (1+K). bias/sin-style loop constants ride the closure,
+        # same as the text side's _scan_stage.
         from neuronx_distributed_llama3_2_tpu.models.llama import _remat_policy
 
         policy = _remat_policy(c.remat)
-        inter_idx = jnp.asarray(c.intermediate_layers_indices, jnp.int32)
-        K = len(c.intermediate_layers_indices)
 
-        def plain_body(carry, xs):
-            h, inter = carry
-            lp, i = xs
-            h = VisionEncoderLayer(c, is_gated=False)(lp, h, bias)
-            keep = (inter_idx == i).astype(inter.dtype)[:, None, None, None]
-            inter = inter * (1 - keep) + h[None].astype(inter.dtype) * keep
-            return (h, inter), None
+        def plain_body(h, lp):
+            return VisionEncoderLayer(c, is_gated=False)(lp, h, bias), None
 
         def gated_body(h, lp):
             return VisionEncoderLayer(c, is_gated=True)(lp, h, bias), None
@@ -631,15 +626,20 @@ class MllamaVisionModel:
             plain_body = jax.checkpoint(plain_body, policy=policy)
             gated_body = jax.checkpoint(gated_body, policy=policy)
 
-        inter0 = jnp.zeros((K,) + hidden.shape, hidden.dtype)
-        (hidden, inter_stack), _ = jax.lax.scan(
-            plain_body,
-            (hidden, inter0),
-            (
-                params["transformer"],
-                jnp.arange(c.num_hidden_layers, dtype=jnp.int32),
-            ),
-        )
+        intermediates: List[jax.Array] = []
+        start = 0
+        for idx in tuple(sorted(c.intermediate_layers_indices)) + (
+            c.num_hidden_layers - 1,
+        ):
+            if idx < start:
+                continue  # final bound may coincide with the last index
+            seg = jax.tree.map(
+                lambda p: p[start:idx + 1], params["transformer"]
+            )
+            hidden, _ = jax.lax.scan(plain_body, hidden, seg)
+            if idx in c.intermediate_layers_indices:
+                intermediates.append(hidden)
+            start = idx + 1
 
         hidden = LayerNorm(c.hidden_size, c.norm_eps, c.dtype)(
             params["layernorm_post"], hidden
@@ -655,7 +655,7 @@ class MllamaVisionModel:
 
         # strip padding, collect (final, intermediates)
         hidden = hidden.reshape(b * m, t, tlen, c.hidden_size)[:, :, :n_pat]
-        inter = jnp.moveaxis(inter_stack, 0, -1)  # (BM, S, H, K)
+        inter = jnp.stack(intermediates, axis=-1)  # (BM, S, H, K)
         inter = inter.reshape(b * m, t, tlen, -1)[:, :, :n_pat]
         out = jnp.concatenate(
             [hidden.reshape(b * m, t, n_pat, c.hidden_size), inter], axis=-1
