@@ -70,8 +70,9 @@ def main():
     import jax
 
     if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from neuronx_distributed_llama3_2_tpu.utils.compat import set_cpu_devices
+
+        set_cpu_devices(args.cpu_devices)
 
     from neuronx_distributed_llama3_2_tpu.inference import (
         GenerationConfig,

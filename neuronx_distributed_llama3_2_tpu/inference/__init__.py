@@ -27,6 +27,7 @@ from neuronx_distributed_llama3_2_tpu.inference.model import (
     KVCache,
     LlamaDecode,
     MixtralDecode,
+    PagedKVCache,
     decode_model_for,
 )
 from neuronx_distributed_llama3_2_tpu.inference.sampling import (
@@ -69,6 +70,7 @@ __all__ = [
     "MixtralDecode",
     "MllamaCache",
     "MllamaDecoder",
+    "PagedKVCache",
     "SamplingConfig",
     "decode_model_for",
     "SpeculativeDecoder",

@@ -71,6 +71,30 @@ class KVCache(NamedTuple):
         return self.k.shape[2]
 
 
+class PagedKVCache(NamedTuple):
+    """Block-pooled KV cache: k/v (L, num_blocks, block_size, n_kv, head_dim).
+
+    The dense cache reserves a full ``max_seq_len`` row per slot; here
+    sequence rows live in fixed-size blocks drawn from one global pool
+    (vLLM PagedAttention, Kwon et al. SOSP 2023) and a per-request *block
+    table* maps logical block index -> pool block id. Block 0 is reserved
+    as the null block: block-table entries past a request's allocated
+    frontier point at it, so bucket-padding writes land in garbage rows
+    that no masked read ever sees.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
 @dataclasses.dataclass(frozen=True)
 class LlamaDecode:
     """Decode-mode Llama sharing the training model's parameter pytree.
@@ -100,6 +124,25 @@ class LlamaDecode:
         dtype = dtype or c.dtype
         shape = (c.num_layers, max_batch, max_len, c.num_kv_heads, c.head_dim)
         return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    def init_paged_cache(
+        self, num_blocks: int, block_size: int, dtype: Any = None
+    ) -> PagedKVCache:
+        """Block-pool cache for the paged serving path (``serving/``):
+        capacity is ``num_blocks * block_size`` token rows shared by every
+        request, instead of ``max_batch * max_seq_len`` dense rows."""
+        c = self.config
+        dtype = dtype or c.dtype
+        shape = (c.num_layers, num_blocks, block_size, c.num_kv_heads, c.head_dim)
+        return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    def paged_cache_specs(self) -> PagedKVCache:
+        """Paged-pool sharding: kv heads over tp (same GQA rule as the dense
+        cache); the pool dim is not sharded — any block must be writable by
+        any request regardless of which dp rank admitted it."""
+        ha = _head_axis(self.config.num_kv_heads)
+        spec = P(None, None, None, ha, None)
+        return PagedKVCache(k=spec, v=spec)
 
     def cache_specs(self, max_batch: Optional[int] = None) -> KVCache:
         """Cache sharding: batch over dp axes, kv heads over tp when
@@ -134,6 +177,7 @@ class LlamaDecode:
         return_hidden: bool = False,
         tree: Optional[Tuple[jax.Array, jax.Array]] = None,
         kv_limit: Optional[int] = None,
+        block_tables: Optional[jax.Array] = None,  # (b, W) int32 pool block ids
     ) -> Tuple[jax.Array, KVCache]:
         """Block-causal forward over the cache.
 
@@ -156,6 +200,12 @@ class LlamaDecode:
         at cache row ``position + i``; within the block, query i attends
         key j iff ``ancestor_mask[i, j]`` (its ancestors on the tree path),
         plus the whole committed prefix.
+
+        ``block_tables``: the paged-KV path. ``cache`` must be a
+        :class:`PagedKVCache` and row ``i``'s logical position ``p`` lives at
+        pool row ``block_tables[i, p // bs] * bs + p % bs``. ``slots`` is
+        ignored (the table IS the indirection). ``kv_limit`` bounds the
+        *logical* rows gathered for attention, exactly as in the dense path.
         """
         c = self.config
         model = self._model()
@@ -172,7 +222,13 @@ class LlamaDecode:
             pos_block = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
         else:
             pos_block = positions[:, None] + tree[0][None, :]
-        sin, cos = self._rope_tables(cache.max_len)
+        if block_tables is None:
+            rope_len = cache.max_len
+        else:
+            # paged: logical capacity is the table width (write positions can
+            # reach the bucket-padding overflow region past max_seq_len)
+            rope_len = block_tables.shape[1] * cache.block_size
+        sin, cos = self._rope_tables(rope_len)
 
         x = model._embed()(params["embed"], tokens)
         x = constrain(x, P(BATCH_AXES, None, None))
@@ -183,6 +239,7 @@ class LlamaDecode:
             x, kc, vc = self._decode_layer(
                 lp, x, kc, vc, sin, cos, pos_block, positions, slots,
                 context_encode=context_encode, tree=tree, kv_limit=kv_limit,
+                block_tables=block_tables,
             )
             return x, (kc, vc)
 
@@ -200,7 +257,7 @@ class LlamaDecode:
             k_new, v_new = jnp.stack(ks), jnp.stack(vs)
 
         x = norm(params["final_norm"], x)
-        new_cache = KVCache(k=k_new, v=v_new)
+        new_cache = type(cache)(k=k_new, v=v_new)
         if return_hidden:
             return x, new_cache
         logits = model._logits(params, x)
@@ -208,11 +265,12 @@ class LlamaDecode:
 
     def _decode_layer(
         self, lp, x, kc, vc, sin, cos, pos_block, positions, slots,
-        *, context_encode: bool, tree=None, kv_limit=None,
+        *, context_encode: bool, tree=None, kv_limit=None, block_tables=None,
     ):
         """One decoder layer with cache read/write.
 
-        kc/vc: (B, S_max, NKV, D) full cache rows for this layer;
+        kc/vc: (B, S_max, NKV, D) full cache rows for this layer — or, under
+        ``block_tables``, the (num_blocks, block_size, NKV, D) pool slice;
         x: (b, T, H). Writes fresh K/V at (slots, pos_block) then attends.
         """
         c = self.config
@@ -239,6 +297,7 @@ class LlamaDecode:
         att, kc, vc = self._attend_with_cache(
             q, k, v, kc, vc, slots, pos_block, positions,
             context_encode=context_encode, tree=tree, kv_limit=kv_limit,
+            block_tables=block_tables,
         )
         att = att.reshape(b, t, c.num_heads * c.head_dim)
         x = x + attn._o()(lp["attn"]["o"], att)
@@ -248,7 +307,7 @@ class LlamaDecode:
 
     def _attend_with_cache(
         self, q, k, v, kc, vc, slots, pos_block, positions,
-        *, context_encode: bool, tree=None, kv_limit=None,
+        *, context_encode: bool, tree=None, kv_limit=None, block_tables=None,
     ):
         """Cache write + attention, shared by every decode family (Llama,
         MoE, GPT-NeoX): scatter the fresh roped K/V into the cache, then
@@ -267,6 +326,12 @@ class LlamaDecode:
             if tree is None
             else positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
         )
+        if block_tables is not None:
+            return self._attend_paged(
+                q, k, v, kc, vc, block_tables, write_rows, pos_block,
+                positions, context_encode=context_encode, tree=tree,
+                kv_limit=kv_limit,
+            )
         kc = kc.at[slots[:, None], write_rows].set(k.astype(kc.dtype))
         vc = vc.at[slots[:, None], write_rows].set(v.astype(vc.dtype))
 
@@ -289,6 +354,52 @@ class LlamaDecode:
             vr = vc if kv_limit is None else vc[:, :kv_limit]
             k_all = jnp.take(kr, slots, axis=0).astype(q.dtype)  # (b,S≤max,NKV,D)
             v_all = jnp.take(vr, slots, axis=0).astype(q.dtype)
+            att = self._cache_attention(
+                q, k_all, v_all, pos_block, ha, positions=positions, tree=tree
+            )
+        return att, kc, vc
+
+    def _attend_paged(
+        self, q, k, v, kc, vc, block_tables, write_rows, pos_block, positions,
+        *, context_encode: bool, tree=None, kv_limit=None,
+    ):
+        """Paged cache write + attention: the block table translates logical
+        sequence rows to pool rows for both the fresh-block scatter and the
+        attention gather. kc/vc: (num_blocks, block_size, NKV, D) per-layer
+        pool slice. Numerically identical to the dense path — the gathered
+        K/V rows carry the same values in the same logical order, and
+        garbage rows (stale blocks, null-block padding) are removed by the
+        same ``j <= position + t`` mask."""
+        c = self.config
+        nb, bs = kc.shape[0], kc.shape[1]
+        kflat = kc.reshape((nb * bs,) + kc.shape[2:])
+        vflat = vc.reshape((nb * bs,) + vc.shape[2:])
+        # logical row p of batch row i -> pool row table[i, p//bs]*bs + p%bs;
+        # rows past the allocated frontier map to the null block (id 0)
+        wr_phys = (
+            jnp.take_along_axis(block_tables, write_rows // bs, axis=1) * bs
+            + write_rows % bs
+        )
+        kflat = kflat.at[wr_phys].set(k.astype(kflat.dtype))
+        vflat = vflat.at[wr_phys].set(v.astype(vflat.dtype))
+        kc, vc = kflat.reshape(kc.shape), vflat.reshape(vc.shape)
+
+        ha = _head_axis(c.num_heads)
+        if context_encode:
+            from neuronx_distributed_llama3_2_tpu.models.llama import (
+                core_attention,
+            )
+
+            att = core_attention(q, k, v, causal=True)
+        else:
+            limit = (
+                kv_limit if kv_limit is not None
+                else block_tables.shape[1] * bs
+            )
+            jlog = jnp.arange(limit, dtype=jnp.int32)
+            rd_phys = block_tables[:, jlog // bs] * bs + (jlog % bs)[None, :]
+            k_all = kflat[rd_phys].astype(q.dtype)  # (b, limit, NKV, D)
+            v_all = vflat[rd_phys].astype(q.dtype)
             att = self._cache_attention(
                 q, k_all, v_all, pos_block, ha, positions=positions, tree=tree
             )
@@ -401,7 +512,7 @@ class GPTNeoXDecode(LlamaDecode):
 
     def _decode_layer(
         self, lp, x, kc, vc, sin, cos, pos_block, positions, slots,
-        *, context_encode: bool, tree=None, kv_limit=None,
+        *, context_encode: bool, tree=None, kv_limit=None, block_tables=None,
     ):
         from neuronx_distributed_llama3_2_tpu.models.gptneox import (
             GPTNeoXAttention,
@@ -429,6 +540,7 @@ class GPTNeoXDecode(LlamaDecode):
         att, kc, vc = self._attend_with_cache(
             q, k, v, kc, vc, slots, pos_block, positions,
             context_encode=context_encode, tree=tree, kv_limit=kv_limit,
+            block_tables=block_tables,
         )
         att = att.reshape(b, t, c.num_heads * c.head_dim)
         attn_out = attn._o()(lp["attn"]["o"], att)

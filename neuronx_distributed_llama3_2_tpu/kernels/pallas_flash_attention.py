@@ -30,6 +30,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from neuronx_distributed_llama3_2_tpu.utils import compat
+
 NEG_INF = float("-inf")
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_KV = 256
@@ -213,7 +215,7 @@ def _flash_fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_kv):
         # (batch·head, q-block) iterations are independent; only the kv dim
         # carries the running-softmax scratch. Telling Mosaic unlocks
         # cross-iteration pipelining it must otherwise assume away.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
@@ -409,7 +411,7 @@ def _flash_bwd(q, k, v, o, lse, do, segment_ids, causal, sm_scale, block_q, bloc
         ),
         out_shape=jax.ShapeDtypeStruct((b, n, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
@@ -455,7 +457,7 @@ def _flash_bwd(q, k, v, o, lse, do, segment_ids, causal, sm_scale, block_q, bloc
             pltpu.VMEM((block_kv, d), jnp.float32),
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),

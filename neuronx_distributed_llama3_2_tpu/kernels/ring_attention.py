@@ -271,7 +271,24 @@ def ring_attention_sharded(
     # executors): the inner shard_map must be built on the CURRENT abstract
     # mesh and list the union of the already-manual axes and ours
     shard_mesh, manual_axes = mesh, {axis_name}
-    abs_mesh = jax.sharding.get_abstract_mesh()
+    from neuronx_distributed_llama3_2_tpu.utils import compat
+
+    if axis_name in compat.legacy_manual_axes():
+        # old-jax full-manual region (compat.shard_map): cp is ALREADY
+        # manual and the inputs are replicated over it, so a nested
+        # shard_map is both impossible (0.4.x rejects re-manual axes) and
+        # unnecessary — slice this device's chunk, run the ring body
+        # directly, and restore cp-replication of the result
+        chunk = seq // cp
+        i0 = lax.axis_index(axis_name) * chunk
+        out = fn(*(lax.dynamic_slice_in_dim(x, i0, chunk, axis=1)
+                   for x in (q, k, v)))
+        out = lax.all_gather(out, axis_name, axis=1, tiled=True)
+        if inv is not None:
+            out = out.take(inv, axis=1)
+        return out
+
+    abs_mesh = compat.get_abstract_mesh()
     if abs_mesh is not None and abs_mesh.axis_names:
         already_manual = {
             n for n, t in zip(abs_mesh.axis_names, abs_mesh.axis_types)
@@ -281,7 +298,7 @@ def ring_attention_sharded(
             shard_mesh = abs_mesh
             manual_axes = already_manual | {axis_name}
 
-    out = jax.shard_map(
+    out = compat.shard_map(
         lambda q, k, v: fn(q, k, v),
         mesh=shard_mesh,
         in_specs=(spec, spec, spec),

@@ -146,7 +146,9 @@ class MoE:
         # inside a partial-manual region (the pp pipeline stage) the nested
         # shard_map must target the ambient abstract mesh (its manual axes
         # are marked) — same rule as layers.constrain / parallel CE
-        ambient = jax.sharding.get_abstract_mesh()
+        from neuronx_distributed_llama3_2_tpu.utils import compat
+
+        ambient = compat.get_abstract_mesh()
         if ambient is not None and not ambient.empty:
             mesh = ambient
         t = x_flat.shape[0]
@@ -195,7 +197,7 @@ class MoE:
             return out, logits, idx
 
         token_spec = P((DP_AXIS, EP_AXIS))
-        return jax.shard_map(
+        return compat.shard_map(
             body,
             mesh=mesh,
             in_specs=(

@@ -75,7 +75,14 @@ def constrain(x: jax.Array, spec: P) -> jax.Array:
     if not parallel_state.model_parallel_is_initialized():
         return x
     mesh = parallel_state.get_parallel_state().mesh
-    ambient = jax.sharding.get_abstract_mesh()
+    from neuronx_distributed_llama3_2_tpu.utils import compat
+
+    if compat.legacy_manual_axes():
+        # old-jax shard_map regions run full-manual (compat.shard_map):
+        # every axis the spec could name is manual, so the constraint has
+        # nothing left to say — and the old partitioner CHECK-fails on it
+        return x
+    ambient = compat.get_abstract_mesh()
     if ambient is not None and not ambient.empty:
         mesh = ambient
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
@@ -329,11 +336,13 @@ class GQAQKVColumnParallelLinear:
             k = k + params["k_bias"]
             v = v + params["v_bias"]
         q = constrain(q, _activation_spec(q, TP_AXIS))
-        # flat-sharded kv keeps the projection tp-sharded too (the consumer
-        # repeats heads and re-shards; see LlamaAttention)
-        kv_axis = (
-            TP_AXIS if self._kv_sharded() or self._kv_flat_sharded() else None
-        )
+        # flat-sharded kv (tp > kv_heads) deliberately leaves the activation
+        # unconstrained: the flat shard boundary (kv_out/tp) falls mid-head,
+        # and pinning that layout miscompiles in older CPU SPMD partitioners
+        # (~5e-3 error) while buying nothing — the consumer repeats heads and
+        # re-constrains to 1 head/device right after (see LlamaAttention).
+        # Only the *kernel* needs the flat sharding (1/tp weight per device).
+        kv_axis = TP_AXIS if self._kv_sharded() else None
         k = constrain(k, _activation_spec(k, kv_axis))
         v = constrain(v, _activation_spec(v, kv_axis))
         return q, k, v

@@ -229,6 +229,11 @@ def initialize_model_parallel(
     )
     mesh = build_mesh(config, devices)
     _PARALLEL_STATE = ParallelState(mesh=mesh, config=config)
+    # Traces cached before this point baked in the old layout (e.g. a model
+    # dataclass jitted pre-init took the dense path); jit keys on the
+    # callable's __eq__/__hash__ plus avals, NOT on this global, so an
+    # eq-equal callable would silently reuse the stale jaxpr. Invalidate.
+    jax.clear_caches()
     logger.info(
         "initialized parallel state: mesh=%s", dict(mesh.shape)
     )
@@ -251,6 +256,8 @@ def destroy_model_parallel() -> None:
     """Reference parallel_state.py:625."""
     global _PARALLEL_STATE
     _PARALLEL_STATE = None
+    # same stale-trace hazard as initialize, in the other direction
+    jax.clear_caches()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,30 @@
+"""Paged KV-cache serving: block pool, radix prefix cache, paged engine.
+
+See docs/serving.md. The dense slot-scheduled path
+(:class:`..inference.engine.ContinuousBatchingEngine`) is unchanged;
+:func:`make_serving_engine` selects between the two.
+"""
+
+from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
+    NULL_BLOCK,
+    BlockAllocator,
+)
+from neuronx_distributed_llama3_2_tpu.serving.engine import (
+    PagedConfig,
+    PagedServingEngine,
+    make_serving_engine,
+)
+from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
+from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
+    RadixPrefixIndex,
+)
+
+__all__ = [
+    "NULL_BLOCK",
+    "BlockAllocator",
+    "PagedConfig",
+    "PagedServingEngine",
+    "RadixPrefixIndex",
+    "ServingMetrics",
+    "make_serving_engine",
+]

@@ -1,0 +1,195 @@
+"""Fixed-size KV block pool: refcounts, copy-on-write, LRU reuse.
+
+vLLM's block manager (PagedAttention, Kwon et al. SOSP 2023) reduced to the
+bookkeeping the paged serving engine needs. The pool's *data* lives in the
+jitted programs' :class:`..inference.model.PagedKVCache`; this class only
+tracks ownership:
+
+- **refcount** — how many active requests address the block through their
+  block tables. Prefix sharing is ``incref``; request teardown is
+  ``release``.
+- **registered** — the :class:`.radix_index.RadixPrefixIndex` maps the
+  block's contents to a token prefix. A registered block whose refcount
+  drops to zero is not freed: it parks in an LRU of *cached* blocks, its KV
+  intact, and is revived by ``incref`` when a later request shares it.
+- **eviction** — ``alloc`` with an empty free list evicts the
+  least-recently-released cached block (plus its radix subtree, via the
+  ``on_evict`` hook) instead of failing; ``alloc`` returns None only when
+  nothing is left to evict — pool exhaustion, which the engine answers with
+  preemption, never a crash.
+- **copy-on-write** — writing into a block someone else can see (refcount
+  > 1, or registered in the index) must first move the writer onto a
+  private copy; :meth:`copy_on_write` does the ownership transfer and tells
+  the caller whether to copy the pool rows.
+
+Block id 0 is reserved as the null block (padding writes) and never
+allocated.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+NULL_BLOCK = 0
+
+
+class BlockAllocator:
+    """Ownership ledger for a pool of ``num_blocks`` fixed-size KV blocks."""
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        on_evict: Optional[Callable[[int], List[int]]] = None,
+    ) -> None:
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is the null block)")
+        if block_size < 1:
+            raise ValueError("block_size must be positive")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # called with the evicted block id; returns the ids of any further
+        # blocks whose cached contents the eviction invalidated (the radix
+        # subtree below the evicted node) so they return to the free list too
+        self.on_evict = on_evict
+        self._free: deque = deque(range(1, num_blocks))
+        self._ref: Dict[int, int] = {}
+        self._registered: set = set()
+        # refcount-0 blocks still holding index-mapped KV, in release order
+        # (oldest release first = LRU victim)
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        self.evictions = 0
+        self.cow_copies = 0
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # excludes the null block
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._cached)
+
+    @property
+    def active_blocks(self) -> int:
+        return len(self._ref)
+
+    def available(self) -> int:
+        """Blocks obtainable right now: free + evictable-cached. The
+        engine's admission-control budget."""
+        return len(self._free) + len(self._cached)
+
+    def utilization(self) -> float:
+        """Fraction of the usable pool held by active requests."""
+        return self.active_blocks / self.usable_blocks
+
+    def refcount(self, bid: int) -> int:
+        return self._ref.get(bid, 0)
+
+    def is_registered(self, bid: int) -> bool:
+        return bid in self._registered
+
+    def stats(self) -> dict:
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "active_blocks": self.active_blocks,
+            "cached_blocks": self.cached_blocks,
+            "free_blocks": self.free_blocks,
+            "block_utilization": round(self.utilization(), 4),
+            "evictions": self.evictions,
+            "cow_copies": self.cow_copies,
+        }
+
+    # -- allocate / share / release ---------------------------------------
+
+    def alloc(self) -> Optional[int]:
+        """One block with refcount 1, evicting cached blocks LRU-first when
+        the free list is empty. None = pool exhausted (every block is held
+        by an active request)."""
+        while not self._free and self._cached:
+            self._evict_one()
+        if not self._free:
+            return None
+        bid = self._free.popleft()
+        self._ref[bid] = 1
+        return bid
+
+    def incref(self, bid: int) -> None:
+        """Share an existing block (prefix admission). Revives a cached
+        (refcount-0, registered) block from the LRU."""
+        if bid in self._cached:
+            del self._cached[bid]
+            self._ref[bid] = 1
+            return
+        self._ref[bid] += 1  # KeyError on a freed id = caller bug
+
+    def release(self, bid: int) -> None:
+        """Drop one reference. At zero the block parks in the cached LRU if
+        the prefix index still maps it, else returns to the free list."""
+        n = self._ref[bid] - 1
+        if n > 0:
+            self._ref[bid] = n
+            return
+        del self._ref[bid]
+        if bid in self._registered:
+            self._cached[bid] = None  # most-recently-released end
+        else:
+            self._free.append(bid)
+
+    # -- index registration -----------------------------------------------
+
+    def register(self, bid: int) -> None:
+        """The prefix index now maps this block's contents."""
+        self._registered.add(bid)
+
+    def unregister(self, bid: int) -> None:
+        """The prefix index dropped its mapping (node replaced/invalidated);
+        a parked block goes straight back to the free list."""
+        self._registered.discard(bid)
+        if bid in self._cached:
+            del self._cached[bid]
+            self._free.append(bid)
+
+    def _evict_one(self) -> None:
+        bid, _ = self._cached.popitem(last=False)  # LRU victim
+        dropped = [bid]
+        if self.on_evict is not None:
+            dropped.extend(self.on_evict(bid))
+        for b in dropped:
+            self._registered.discard(b)
+            if b in self._ref:
+                # defensive: an active sharer keeps the data alive; the
+                # index mapping is gone but the block is not reusable yet
+                continue
+            if b != bid:
+                self._cached.pop(b, None)
+            self._free.append(b)
+            self.evictions += 1
+
+    # -- copy-on-write -----------------------------------------------------
+
+    def writable(self, bid: int) -> bool:
+        """True when a write cannot corrupt anyone else's view: sole active
+        owner AND the prefix index does not map the contents."""
+        return self._ref.get(bid) == 1 and bid not in self._registered
+
+    def copy_on_write(self, bid: int) -> Tuple[Optional[int], bool]:
+        """Make the caller's block writable. Returns ``(block, needs_copy)``:
+        the caller holds one ref on ``bid``; when ``needs_copy`` the ref has
+        moved to a fresh private block and the caller must copy the pool
+        rows ``bid -> block``. ``(None, False)`` = pool exhausted."""
+        if self.writable(bid):
+            return bid, False
+        new = self.alloc()
+        if new is None:
+            return None, False
+        self.release(bid)
+        self.cow_copies += 1
+        return new, True

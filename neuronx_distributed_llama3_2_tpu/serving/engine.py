@@ -1,0 +1,557 @@
+"""Paged serving engine: block-budget admission, prefix-cached prefill,
+preempt-and-requeue under pool pressure.
+
+:class:`..inference.engine.ContinuousBatchingEngine` schedules *slots*:
+every admitted request owns a dense ``max_seq_len`` KV row, so capacity is
+fixed at ``max_batch`` regardless of how short requests actually are, and
+identical prompt prefixes are re-prefilled from scratch. This engine keeps
+the slot scheduler's decode shape (one batched T=1 program advancing every
+active lane) but replaces the memory model underneath:
+
+- KV rows live in a global pool of fixed-size blocks
+  (:class:`..inference.model.PagedKVCache`); each request carries a block
+  table and the jitted programs translate logical rows through it
+  (vLLM PagedAttention).
+- A :class:`.radix_index.RadixPrefixIndex` maps token prefixes to block
+  chains: a new request's shared prefix is admitted *by reference*
+  (reported as ``cached_tokens``) and only the suffix is prefilled
+  (SGLang RadixAttention).
+- Admission is block-budget control: admit while free + evictable blocks
+  cover the prompt plus a decode reserve. On pool exhaustion mid-decode the
+  youngest request is preempted and requeued (its registered prefix blocks
+  park in the cached LRU, so resumption usually re-admits by reference) —
+  never an exception out of :meth:`step`.
+
+Greedy outputs are token-identical to the dense engine: the paged gather
+feeds the same K/V values in the same logical order to the same
+``_cache_attention``, and masked garbage rows contribute exactly zero.
+Stochastic sampling is supported but consumes a different rng-split order
+than the dense engine, so sampled streams are valid, not bit-matching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_llama3_2_tpu.inference.engine import (
+    GenerationConfig,
+    InferenceEngine,
+    pick_bucket,
+)
+from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+    SamplingConfig,
+    sample,
+)
+from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
+    NULL_BLOCK,
+    BlockAllocator,
+)
+from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
+from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
+    RadixPrefixIndex,
+)
+from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedConfig:
+    """Knobs for the paged KV pool (see docs/serving.md)."""
+
+    block_size: int = 16
+    # pool size INCLUDING the reserved null block (id 0): usable capacity is
+    # (num_blocks - 1) * block_size token rows shared by all requests
+    num_blocks: int = 128
+    # admission headroom: blocks a request must be able to claim beyond its
+    # prompt before it is admitted, delaying the first preemption
+    decode_reserve_blocks: int = 2
+    enable_prefix_caching: bool = True
+    cache_dtype: Any = None
+    metrics_log_every: int = 0  # decode steps between metric log lines; 0=off
+
+
+@dataclasses.dataclass
+class _PagedRequest:
+    rid: int
+    prompt: List[int]
+    out: List[int]
+    lane: Optional[int] = None
+    table: List[int] = dataclasses.field(default_factory=list)
+    position: int = 0            # == len(prompt + out) - 1 while active
+    cached_tokens: int = 0       # cumulative across (re-)admissions
+    preemptions: int = 0
+    done: bool = False
+
+
+class PagedServingEngine:
+    """Block-granular continuous batching over an :class:`InferenceEngine`'s
+    model/params. The dense engine's cache and programs are untouched — the
+    paged path is opt-in (construct this class, or
+    :func:`make_serving_engine` with a :class:`PagedConfig`)."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        gen: GenerationConfig = GenerationConfig(),
+        paged: PagedConfig = PagedConfig(),
+        precompile: bool = True,
+    ) -> None:
+        self.engine = engine
+        self.model = engine.model
+        self.gen = gen
+        self.paged = paged
+        bs = paged.block_size
+        if bs < 1:
+            raise ValueError("block_size must be positive")
+        if paged.decode_reserve_blocks < 1:
+            # a solo request's re-admission after self-preemption is only
+            # guaranteed to fit when admission kept >= 1 block of headroom
+            raise ValueError("decode_reserve_blocks must be >= 1")
+        # suffix prefill must route any length <= max_seq_len even when the
+        # bucket ladder tops out early (dense decode has the same fallback)
+        self._prefill_buckets = list(engine.buckets)
+        if self._prefill_buckets[-1] < engine.max_seq_len:
+            self._prefill_buckets.append(engine.max_seq_len)
+        # table width: logical blocks covering max_seq_len, plus overflow
+        # entries (always null) absorbing bucket-padding writes past it —
+        # sized by the largest prefill bucket so a padded suffix prefill
+        # starting near max_seq_len still indexes inside the table
+        self.table_width = _ceil_div(engine.max_seq_len, bs) + _ceil_div(
+            self._prefill_buckets[-1], bs
+        )
+        self.cache = self.model.init_paged_cache(
+            paged.num_blocks, bs, paged.cache_dtype
+        )
+        from neuronx_distributed_llama3_2_tpu.parallel import (
+            state as parallel_state,
+        )
+
+        if parallel_state.model_parallel_is_initialized():
+            from neuronx_distributed_llama3_2_tpu.parallel.layers import (
+                shard_pytree,
+            )
+
+            self.cache = shard_pytree(self.cache, self.model.paged_cache_specs())
+        self.allocator = BlockAllocator(paged.num_blocks, bs)
+        self.index = RadixPrefixIndex(self.allocator)
+        self.metrics = ServingMetrics()
+
+        self._next_rid = 0
+        self._queue: List[_PagedRequest] = []
+        self._active: Dict[int, _PagedRequest] = {}  # lane -> request
+        self._finished: Dict[int, _PagedRequest] = {}
+        self._free_lanes = list(range(engine.max_batch))
+        self._key = jax.random.key(gen.seed)
+        self._tokens = np.zeros((engine.max_batch,), np.int32)
+        self._positions = np.zeros((engine.max_batch,), np.int32)
+        self._tables = np.full(
+            (engine.max_batch, self.table_width), NULL_BLOCK, np.int32
+        )
+        self._programs: Dict[tuple, Any] = {}
+        self._copy_block_fn = jax.jit(
+            lambda c, s, d: type(c)(
+                k=c.k.at[:, d].set(c.k[:, s]),
+                v=c.v.at[:, d].set(c.v[:, s]),
+            ),
+            donate_argnums=(0,),
+        )
+        if precompile:
+            self._warmup()
+
+    # -- programs ----------------------------------------------------------
+
+    def _prefill_ctx_program(self, bucket: int, cfg: SamplingConfig):
+        """Whole-prompt prefill (no cached prefix): context-encode forward +
+        last-token gather + on-device sample, paged writes."""
+        key_ = ("pctx", bucket, cfg)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model, engine = self.model, self.engine
+
+        def fn(params, cache, ids, length, table, key):
+            params = engine._live_params(params)
+            positions = jnp.zeros((ids.shape[0],), jnp.int32)
+            hidden, cache = model.forward(
+                params, cache, ids, positions, None,
+                context_encode=True, return_hidden=True, block_tables=table,
+            )
+            last = jnp.take_along_axis(
+                hidden, (length - 1)[:, None, None], axis=1
+            )
+            logits = model._model()._logits(params, last)[:, 0, :]
+            return sample(logits, key, cfg), cache
+
+        self._programs[key_] = jax.jit(fn, donate_argnums=(1,))
+        return self._programs[key_]
+
+    def _prefill_suffix_program(
+        self, bucket: int, kv_limit: int, cfg: SamplingConfig
+    ):
+        """Suffix prefill after a prefix-cache hit: the fresh block starts at
+        position ``start`` (the cached length) and attends over the shared
+        prefix blocks through the table — the cached tokens are never
+        recomputed."""
+        key_ = ("psfx", bucket, kv_limit, cfg)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model, engine = self.model, self.engine
+
+        def fn(params, cache, ids, start, length, table, key):
+            params = engine._live_params(params)
+            hidden, cache = model.forward(
+                params, cache, ids, start, None,
+                return_hidden=True, block_tables=table, kv_limit=kv_limit,
+            )
+            last = jnp.take_along_axis(
+                hidden, (length - 1)[:, None, None], axis=1
+            )
+            logits = model._model()._logits(params, last)[:, 0, :]
+            return sample(logits, key, cfg), cache
+
+        self._programs[key_] = jax.jit(fn, donate_argnums=(1,))
+        return self._programs[key_]
+
+    def _decode_program(self, cfg: SamplingConfig, kv_limit: int):
+        key_ = ("pdecode", cfg, kv_limit)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model, engine = self.model, self.engine
+
+        def fn(params, cache, tokens, positions, tables, key):
+            params = engine._live_params(params)
+            logits, cache = model.forward(
+                params, cache, tokens[:, None], positions, None,
+                block_tables=tables, kv_limit=kv_limit,
+            )
+            return sample(logits[:, 0, :], key, cfg), cache
+
+        self._programs[key_] = jax.jit(fn, donate_argnums=(1,))
+        return self._programs[key_]
+
+    def _warmup(self) -> None:
+        """Compile the decode program per kv bucket and the no-cache prefill
+        per context bucket before traffic. Warmup calls write only into the
+        null block (all-null tables), which is garbage by definition.
+        Suffix-prefill programs (per cached-length bucket pair) still
+        compile lazily on first hit — chunked prefill will collapse that
+        program family."""
+        eng = self.engine
+        kv_buckets = list(eng.buckets)
+        if kv_buckets[-1] < eng.max_seq_len:
+            kv_buckets.append(eng.max_seq_len)
+        key = jax.random.key(0)
+        tables = jnp.asarray(self._tables)
+        zeros_b = jnp.zeros((eng.max_batch,), jnp.int32)
+        for kv in kv_buckets:
+            fn = self._decode_program(self.gen.sampling, kv)
+            _, self.cache = fn(
+                eng.params, self.cache, zeros_b, zeros_b, tables, key
+            )
+        table1 = jnp.full((1, self.table_width), NULL_BLOCK, jnp.int32)
+        for bucket in eng.buckets:
+            fn = self._prefill_ctx_program(bucket, self.gen.sampling)
+            _, self.cache = fn(
+                eng.params, self.cache, jnp.zeros((1, bucket), jnp.int32),
+                jnp.ones((1,), jnp.int32), table1, key,
+            )
+
+    # -- request lifecycle -------------------------------------------------
+
+    def submit(self, prompt: Sequence[int]) -> int:
+        if len(prompt) + self.gen.max_new_tokens > self.engine.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({self.gen.max_new_tokens}) exceeds cache capacity "
+                f"({self.engine.max_seq_len})"
+            )
+        bs = self.paged.block_size
+        worst = (
+            _ceil_div(len(prompt) + self.gen.max_new_tokens, bs)
+            + self.paged.decode_reserve_blocks
+        )
+        if worst > self.allocator.usable_blocks:
+            raise ValueError(
+                f"request needs up to {worst} KV blocks but the pool has "
+                f"{self.allocator.usable_blocks} usable blocks — raise "
+                f"PagedConfig.num_blocks or shrink max_new_tokens"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_PagedRequest(rid=rid, prompt=list(prompt), out=[]))
+        self.metrics.submitted += 1
+        return rid
+
+    def _admit(self) -> None:
+        bs = self.paged.block_size
+        alloc = self.allocator
+        while self._queue and self._free_lanes:
+            req = self._queue[0]
+            seq = req.prompt + req.out  # resume re-prefills generated tokens
+            if self.paged.enable_prefix_caching:
+                matched, mblocks = self.index.match(seq)
+            else:
+                matched, mblocks = 0, []
+            # always leave >= 1 token to prefill: the admission forward must
+            # produce the logits at the last position
+            cached = min(matched, len(seq) - 1)
+            n_total = _ceil_div(len(seq), bs)
+            n_shared_full = cached // bs
+            need_new = (n_total - n_shared_full) + self.paged.decode_reserve_blocks
+            if alloc.available() < need_new:
+                return  # FCFS head-of-line: wait for blocks to drain
+            self._queue.pop(0)
+            # take shared refs BEFORE allocating, so our own allocations
+            # cannot evict the blocks we are about to use
+            table = list(mblocks[: _ceil_div(cached, bs)])
+            for b in table:
+                alloc.incref(b)
+            ok = True
+            if cached % bs:
+                # partially shared last block: the suffix's first write lands
+                # inside it -> move onto a private copy now
+                src = table[-1]
+                wb, copied = alloc.copy_on_write(src)
+                if wb is None:
+                    ok = False
+                else:
+                    if copied:
+                        self.cache = self._copy_block_fn(
+                            self.cache,
+                            jnp.asarray(src, jnp.int32),
+                            jnp.asarray(wb, jnp.int32),
+                        )
+                    table[-1] = wb
+            while ok and len(table) < n_total:
+                nb = alloc.alloc()
+                if nb is None:
+                    ok = False
+                else:
+                    table.append(nb)
+            if not ok:
+                # lost the budget race (should not happen: available() was
+                # checked); back off cleanly and retry next step
+                for b in table:
+                    alloc.release(b)
+                self._queue.insert(0, req)
+                return
+            lane = self._free_lanes.pop(0)
+            suffix = seq[cached:]
+            self._key, k = jax.random.split(self._key)
+            first = self._prefill(suffix, cached, table, k)
+            req.out.append(first)
+            req.lane = lane
+            req.table = table
+            req.position = len(seq)
+            req.cached_tokens += cached
+            self._tokens[lane] = first
+            self._positions[lane] = req.position
+            self._tables[lane, :] = NULL_BLOCK
+            self._tables[lane, : len(table)] = table
+            self._active[lane] = req
+            self.metrics.admitted += 1
+            self.metrics.prefill_tokens += len(suffix)
+            self.metrics.cached_tokens += cached
+            if self.paged.enable_prefix_caching:
+                # register the prompt's full blocks immediately so requests
+                # admitted later in this same wave share them; the partial
+                # tail block stays private (decode writes into it)
+                n_full = len(seq) // bs
+                if n_full:
+                    self.index.insert(seq[: n_full * bs], table[:n_full])
+            self._maybe_finish(req)
+
+    def _prefill(
+        self, suffix: List[int], cached: int, table: List[int], key
+    ) -> int:
+        eng = self.engine
+        bucket = pick_bucket(self._prefill_buckets, max(len(suffix), 1))
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, : len(suffix)] = suffix
+        length = np.asarray([max(len(suffix), 1)], np.int32)
+        tbl = np.full((1, self.table_width), NULL_BLOCK, np.int32)
+        tbl[0, : len(table)] = table
+        if cached == 0:
+            fn = self._prefill_ctx_program(bucket, self.gen.sampling)
+            tok, self.cache = fn(
+                eng.params, self.cache, jnp.asarray(ids),
+                jnp.asarray(length), jnp.asarray(tbl), key,
+            )
+        else:
+            kv_limit = eng._kv_bucket(min(cached + bucket, eng.max_seq_len))
+            fn = self._prefill_suffix_program(bucket, kv_limit, self.gen.sampling)
+            tok, self.cache = fn(
+                eng.params, self.cache, jnp.asarray(ids),
+                jnp.asarray([cached], np.int32), jnp.asarray(length),
+                jnp.asarray(tbl), key,
+            )
+        return int(np.asarray(jax.device_get(tok))[0])
+
+    def _preempt(self, req: _PagedRequest) -> None:
+        """Pool exhausted: bump the request back to the queue head. Its
+        registered prefix blocks park in the cached LRU, so re-admission
+        usually re-shares them instead of re-prefilling from scratch."""
+        lane = req.lane
+        for b in req.table:
+            self.allocator.release(b)
+        req.table = []
+        req.lane = None
+        req.position = 0
+        del self._active[lane]
+        self._free_lanes.append(lane)
+        self._tables[lane, :] = NULL_BLOCK
+        self._tokens[lane] = 0
+        self._positions[lane] = 0
+        self._queue.insert(0, req)
+        req.preemptions += 1
+        self.metrics.preemptions += 1
+        logger.debug(
+            "preempted request %d (pool exhausted): %d generated so far",
+            req.rid, len(req.out),
+        )
+
+    def _ensure_decode_blocks(self) -> None:
+        """Every active lane's next write row must be backed by a real
+        block; allocate on block boundaries, preempting the youngest active
+        request when the pool (free + evictable) runs dry."""
+        bs = self.paged.block_size
+        for lane in sorted(self._active, key=lambda l: self._active[l].rid):
+            req = self._active.get(lane)
+            if req is None:
+                continue  # preempted while servicing an older lane
+            if req.position // bs < len(req.table):
+                continue
+            while True:
+                nb = self.allocator.alloc()
+                if nb is not None:
+                    req.table.append(nb)
+                    self._tables[lane, len(req.table) - 1] = nb
+                    break
+                victim = max(self._active.values(), key=lambda r: r.rid)
+                self._preempt(victim)
+                if victim is req:
+                    break  # preempted ourselves; nothing left to back
+
+    def _maybe_finish(self, req: _PagedRequest) -> None:
+        eos = self.gen.eos_token_id
+        if not (
+            req.done
+            or (eos is not None and req.out and req.out[-1] == eos)
+            or len(req.out) >= self.gen.max_new_tokens
+        ):
+            return
+        req.done = True
+        bs = self.paged.block_size
+        if self.paged.enable_prefix_caching and req.table:
+            # cache the whole materialized sequence (prompt + generated):
+            # rows [0, position) are valid — the final token's KV was never
+            # written, so it is excluded
+            seq = (req.prompt + req.out)[: req.position]
+            self.index.insert(seq, req.table[: _ceil_div(req.position, bs)])
+        if req.lane is not None:
+            lane = req.lane
+            for b in req.table:
+                self.allocator.release(b)
+            req.table = []
+            del self._active[lane]
+            self._free_lanes.append(lane)
+            self._tables[lane, :] = NULL_BLOCK
+            self._tokens[lane] = 0
+            self._positions[lane] = 0
+            req.lane = None
+        self._finished[req.rid] = req
+        self.metrics.finished += 1
+
+    # -- serving loop -------------------------------------------------------
+
+    def step(self) -> bool:
+        """Admit waiting requests, advance every active lane one token.
+        Pool exhaustion preempts-and-requeues instead of raising. Returns
+        False when nothing is left to do."""
+        self._admit()
+        if not self._active:
+            return bool(self._queue)
+        self._ensure_decode_blocks()
+        if not self._active:
+            return bool(self._queue)  # everyone preempted; re-admit next step
+        eng = self.engine
+        kv_limit = eng._kv_bucket(
+            int(max(self._positions[l] for l in self._active)) + 1
+        )
+        fn = self._decode_program(self.gen.sampling, kv_limit)
+        self._key, k = jax.random.split(self._key)
+        toks, self.cache = fn(
+            eng.params, self.cache,
+            jnp.asarray(self._tokens), jnp.asarray(self._positions),
+            jnp.asarray(self._tables), k,
+        )
+        toks = np.asarray(jax.device_get(toks))
+        self.metrics.decode_steps += 1
+        for lane, req in list(self._active.items()):
+            req.out.append(int(toks[lane]))
+            req.position += 1
+            self._tokens[lane] = toks[lane]
+            self._positions[lane] = req.position
+            if req.position >= eng.max_seq_len - 1:
+                req.done = True
+            self._maybe_finish(req)
+        every = self.paged.metrics_log_every
+        if every and self.metrics.decode_steps % every == 0:
+            self.metrics.log(logger, self.allocator, self.index)
+        return bool(self._active or self._queue)
+
+    def run_to_completion(self) -> Dict[int, List[int]]:
+        while self.step():
+            pass
+        return {rid: r.out for rid, r in sorted(self._finished.items())}
+
+    def request_info(self, rid: int) -> dict:
+        """Per-request serving stats (``cached_tokens`` is the per-request
+        prefix-cache report the protocol layer surfaces)."""
+        for pool in (self._finished, ):
+            if rid in pool:
+                req = pool[rid]
+                break
+        else:
+            req = next(
+                (r for r in list(self._active.values()) + self._queue
+                 if r.rid == rid),
+                None,
+            )
+        if req is None:
+            raise KeyError(f"unknown request id {rid}")
+        return {
+            "rid": req.rid,
+            "prompt_tokens": len(req.prompt),
+            "generated_tokens": len(req.out),
+            "cached_tokens": req.cached_tokens,
+            "preemptions": req.preemptions,
+            "done": req.done,
+        }
+
+
+def make_serving_engine(
+    engine: InferenceEngine,
+    gen: GenerationConfig = GenerationConfig(),
+    paged: Optional[PagedConfig] = None,
+    precompile: bool = True,
+):
+    """The serving-path config flag: ``paged=None`` keeps the dense
+    slot-scheduled engine; a :class:`PagedConfig` opts into the block pool
+    + radix prefix caching."""
+    if paged is None:
+        from neuronx_distributed_llama3_2_tpu.inference.engine import (
+            ContinuousBatchingEngine,
+        )
+
+        return ContinuousBatchingEngine(engine, gen, precompile=precompile)
+    return PagedServingEngine(engine, gen, paged, precompile=precompile)

@@ -1,0 +1,55 @@
+"""Serving counters: block utilization, prefix hit-rate, preemptions.
+
+Follows the ``trainer/metrics.py`` house style — plain counters with a
+``snapshot()`` that merges in allocator/index state, loggable as one JSON
+object (the serving-side analogue of ``TrainingMetrics``'s jsonl records).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
+    BlockAllocator,
+)
+from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
+    RadixPrefixIndex,
+)
+
+
+@dataclasses.dataclass
+class ServingMetrics:
+    """Counters owned by :class:`.engine.PagedServingEngine`."""
+
+    submitted: int = 0
+    admitted: int = 0
+    finished: int = 0
+    truncated: int = 0        # finished early because the pool can never fit
+    preemptions: int = 0      # requests bumped back to the queue
+    decode_steps: int = 0
+    prefill_tokens: int = 0   # prompt tokens actually pushed through prefill
+    cached_tokens: int = 0    # prompt tokens admitted by prefix reference
+
+    def prefix_skip_fraction(self) -> float:
+        """Fraction of admitted prompt tokens that skipped prefill."""
+        total = self.prefill_tokens + self.cached_tokens
+        return self.cached_tokens / total if total else 0.0
+
+    def snapshot(
+        self,
+        allocator: Optional[BlockAllocator] = None,
+        index: Optional[RadixPrefixIndex] = None,
+    ) -> dict:
+        rec = dataclasses.asdict(self)
+        rec["prefix_skip_fraction"] = round(self.prefix_skip_fraction(), 4)
+        if allocator is not None:
+            rec.update(allocator.stats())
+        if index is not None:
+            rec["prefix_hit_rate"] = round(index.hit_rate(), 4)
+            rec["radix_nodes"] = index.num_nodes
+        return rec
+
+    def log(self, logger, allocator=None, index=None) -> None:
+        logger.info("serving metrics: %s", json.dumps(self.snapshot(allocator, index)))

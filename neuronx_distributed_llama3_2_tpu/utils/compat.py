@@ -1,0 +1,124 @@
+"""jax version compatibility shims.
+
+The repo targets the current jax API but must stay runnable on the jax
+0.4.x line (the CPU test tier and the bench scripts run wherever the
+container's jax is). Everything version-dependent goes through here so a
+call site never needs its own try/except.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def get_abstract_mesh():
+    """``jax.sharding.get_abstract_mesh()`` where it exists (jax >= 0.5).
+
+    Older jax has no abstract-mesh API — and therefore no partial-manual
+    ``shard_map`` regions to detect — so ``None`` (caller keeps the
+    concrete mesh) is the faithful answer, not a degradation."""
+    fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    return fn() if fn is not None else None
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None, check_vma=True):
+    """``jax.shard_map`` (jax >= 0.6 surface) on any jax.
+
+    On 0.4.x this lowers to ``jax.experimental.shard_map.shard_map`` with
+    ``check_rep`` (the old spelling of ``check_vma``) and NO ``auto``
+    complement: partial-auto regions with ``lax.axis_index`` inside
+    CHECK-fail in that era's SPMD partitioner (PartitionId is unsupported),
+    aborting the process. Full-manual is numerically identical — axes a
+    spec doesn't mention replicate instead of staying GSPMD-auto, which
+    only costs sharding efficiency, not correctness, and the 0.4.x line
+    is only the CPU test tier here."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    def traced(*a, **k):
+        # mark the region for legacy_manual_axes() while the body traces:
+        # sharding constraints inside must drop (every axis is manual here,
+        # and the old partitioner CHECK-fails on mixed-manual annotations)
+        _LEGACY_MANUAL.append(frozenset(mesh.axis_names))
+        try:
+            return f(*a, **k)
+        finally:
+            _LEGACY_MANUAL.pop()
+
+    return legacy(traced, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
+
+_LEGACY_MANUAL: list = []
+
+
+def legacy_manual_axes() -> frozenset:
+    """Mesh axes manual in the innermost legacy (0.4.x) shard_map region
+    currently being traced — empty on new jax, where the abstract mesh
+    carries this information instead."""
+    return _LEGACY_MANUAL[-1] if _LEGACY_MANUAL else frozenset()
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` (jax >= 0.5); older jax counts via a psum of 1,
+    which folds to a trace-time constant inside shard_map."""
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    return fn(axis_name) if fn is not None else lax.psum(1, axis_name)
+
+
+def set_mesh(mesh):
+    """``jax.sharding.set_mesh(mesh)`` context on any jax.
+
+    Older jax has no ambient-mesh setter; the legacy ``with mesh:`` context
+    is the nearest equivalent (named-sharding resolution inside jit). Call
+    sites here always pass explicit ``mesh=`` to shard_map anyway, so the
+    context only needs to not crash."""
+    fn = getattr(jax.sharding, "set_mesh", None)
+    return fn(mesh) if fn is not None else mesh
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (renamed from ``TPUCompilerParams``)."""
+    import jax.experimental.pallas.tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def cost_analysis(compiled) -> dict:
+    """``Compiled.cost_analysis()`` as a flat dict on any jax: the 0.4.x
+    line returns a one-entry list of dicts (one per partition), newer jax
+    returns the dict directly."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca
+
+
+def set_cpu_devices(n: int) -> None:
+    """Force an ``n``-device virtual CPU backend, portable across jax
+    versions. Must run before the backend initializes (first ``devices()``
+    / first compile), same constraint as the underlying knobs."""
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # jax < 0.5: the XLA flag is the pre-initialization equivalent.
+        # Replace (not skip) an inherited count — a subprocess may need a
+        # bigger virtual mesh than its parent exported.
+        flags = [
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)

@@ -180,9 +180,14 @@ def run_session(
             if stream is not None:
                 slim = dict(rec)
                 slim["tail"] = slim["tail"][-400:]
-                print(json.dumps(slim), file=stream, flush=True)
+                # one write + one flush so the record and its echoed
+                # headline can't interleave with concurrent writers on the
+                # shared stream (tmux pipe-pane readers split on lines)
+                out = json.dumps(slim) + "\n"
                 if echo_line:
-                    print(echo_line, file=stream, flush=True)
+                    out += echo_line + "\n"
+                stream.write(out)
+                stream.flush()
             print(f"[{rec['status']:>7}] {rec['stage']} ({rec['seconds']}s)",
                   file=sys.stderr, flush=True)
 

@@ -166,8 +166,9 @@ def main() -> None:
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", TP * PP)
+    from neuronx_distributed_llama3_2_tpu.utils.compat import set_cpu_devices
+
+    set_cpu_devices(TP * PP)
 
     print(
         json.dumps(compute_plan(args.devices_per_process, args.model)),

@@ -67,6 +67,7 @@ def measured(pp: int = 4, vocab: int = 8192):
     )
     from neuronx_distributed_llama3_2_tpu.parallel import state as parallel_state
     from neuronx_distributed_llama3_2_tpu.pipeline import PipelinedCausalLM
+    from neuronx_distributed_llama3_2_tpu.utils import compat
     from neuronx_distributed_llama3_2_tpu.trainer import (
         OptimizerConfig,
         TrainingConfig,
@@ -96,7 +97,7 @@ def measured(pp: int = 4, vocab: int = 8192):
             jnp.int32,
         )
         lowered = step.lower(state, {"input_ids": ids, "labels": ids})
-        cost = lowered.compile().cost_analysis()
+        cost = compat.cost_analysis(lowered.compile())
         out["split" if split else "unsplit"] = float(cost.get("flops", -1))
         # loss must agree between the two modes
         _, metrics = step(state, {"input_ids": ids, "labels": ids})
@@ -118,8 +119,9 @@ def main() -> None:
     # any repo import can touch the (possibly hung) axon relay
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from neuronx_distributed_llama3_2_tpu.utils.compat import set_cpu_devices
+
+    set_cpu_devices(8)
     result = {"bench": "1f1b_head_waste", "analytic": analytic_rows()}
     if not args.no_measure:
         result["measured_cpu_mesh"] = measured(pp=args.pp)

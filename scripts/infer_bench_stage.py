@@ -51,8 +51,9 @@ def main() -> None:
     import jax
 
     if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from neuronx_distributed_llama3_2_tpu.utils.compat import set_cpu_devices
+
+        set_cpu_devices(args.cpu_devices)
 
     from neuronx_distributed_llama3_2_tpu.inference import InferenceEngine
     from neuronx_distributed_llama3_2_tpu.inference import runner as bench_runner

@@ -92,8 +92,8 @@ def cp_gate(seq: int) -> None:
 
     code = f"""
 import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from neuronx_distributed_llama3_2_tpu.utils.compat import set_cpu_devices
+set_cpu_devices(8)
 import json, time
 import jax.numpy as jnp, numpy as np
 from neuronx_distributed_llama3_2_tpu.kernels.ring_attention import ring_attention_sharded

@@ -263,7 +263,9 @@ def main() -> None:
     # 64 virtual devices: the (tp=8, dp=8) mesh must EXIST for the ZeRO-1
     # spec generation to dp-shard exactly as v5e-64 would (dp=1 meshes
     # skip the dp dimension entirely)
-    jax.config.update("jax_num_cpu_devices", 64)
+    from neuronx_distributed_llama3_2_tpu.utils.compat import set_cpu_devices
+
+    set_cpu_devices(64)
 
     result = {"plan": "mllama_11b_v5e64", "hbm_per_chip_GB": HBM_PER_CHIP_GB}
     result["exact"] = exact_param_plan()

@@ -17,8 +17,10 @@ import time
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from neuronx_distributed_llama3_2_tpu.utils import compat
+from neuronx_distributed_llama3_2_tpu.utils.compat import set_cpu_devices
+
+set_cpu_devices(8)
 
 import jax.numpy as jnp
 import numpy as np
@@ -70,7 +72,7 @@ def main() -> None:
         pv = shard_pytree(pm.to_pipeline(params), pm.specs())
         lowered = jax.jit(grad_fn).lower(pv, ids, ids)
         compiled = lowered.compile()
-        flops = compiled.cost_analysis().get("flops", float("nan"))
+        flops = compat.cost_analysis(compiled).get("flops", float("nan"))
         t0 = time.perf_counter()
         out = compiled(pv, ids, ids)
         jax.block_until_ready(out)

@@ -27,8 +27,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from neuronx_distributed_llama3_2_tpu.utils.compat import set_cpu_devices
+
+set_cpu_devices(8)
 
 import jax.numpy as jnp
 import numpy as np

@@ -13,7 +13,18 @@ import jax
 # jax may already be imported by the environment's sitecustomize with a TPU
 # backend registered; config.update (not env vars) is the reliable override.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no num_cpu_devices config; the XLA flag is the
+    # equivalent as long as it lands before the backend initializes
+    # (importing jax alone does not initialize it)
+    flags = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=8")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
 jax.config.update("jax_threefry_partitionable", True)
 
 # Persistent XLA compile cache: the suite is compile-dominated (hundreds of

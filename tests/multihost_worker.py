@@ -12,8 +12,9 @@ import sys
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+from neuronx_distributed_llama3_2_tpu.utils.compat import set_cpu_devices
+
+set_cpu_devices(4)
 
 import numpy as np
 import jax.numpy as jnp

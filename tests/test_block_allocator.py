@@ -1,0 +1,263 @@
+"""Unit tests for the serving block pool: refcount / copy-on-write /
+LRU-eviction invariants, and the radix prefix index over it.
+
+Pure-Python (no JAX programs): the allocator and index are host-side
+bookkeeping; the pool *data* paths are covered by tests/test_paged_serving.py.
+"""
+
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.serving import (
+    NULL_BLOCK,
+    BlockAllocator,
+    RadixPrefixIndex,
+)
+
+# ---------------------------------------------------------------------------
+# BlockAllocator
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_never_returns_null_block_and_exhausts_to_none():
+    a = BlockAllocator(num_blocks=4, block_size=8)
+    got = [a.alloc() for _ in range(3)]
+    assert NULL_BLOCK not in got
+    assert sorted(got) == [1, 2, 3]
+    assert a.alloc() is None  # every block held by an active request
+    assert a.usable_blocks == 3
+    assert a.free_blocks == 0
+
+
+def test_refcount_release_returns_unregistered_to_free():
+    a = BlockAllocator(num_blocks=4, block_size=8)
+    b = a.alloc()
+    a.incref(b)
+    assert a.refcount(b) == 2
+    a.release(b)
+    assert a.refcount(b) == 1
+    assert a.free_blocks == 2  # still held
+    a.release(b)
+    assert a.refcount(b) == 0
+    assert a.free_blocks == 3  # unregistered -> straight back to free
+
+
+def test_registered_release_parks_in_cache_and_incref_revives():
+    a = BlockAllocator(num_blocks=4, block_size=8)
+    b = a.alloc()
+    a.register(b)
+    a.release(b)
+    assert a.free_blocks == 2
+    assert a.cached_blocks == 1  # parked, KV intact
+    assert a.available() == 3    # cached blocks still count as obtainable
+    a.incref(b)                  # prefix hit revives it
+    assert a.cached_blocks == 0
+    assert a.refcount(b) == 1
+
+
+def test_alloc_evicts_least_recently_released_first():
+    a = BlockAllocator(num_blocks=4, block_size=8)
+    b1, b2, b3 = a.alloc(), a.alloc(), a.alloc()
+    for b in (b1, b2, b3):
+        a.register(b)
+    a.release(b2)  # oldest release = LRU victim
+    a.release(b1)
+    a.release(b3)
+    got = a.alloc()
+    assert got == b2
+    assert a.evictions == 1
+    assert not a.is_registered(b2)  # eviction drops the registration
+    assert a.alloc() == b1
+    assert a.alloc() == b3
+
+
+def test_eviction_hook_frees_the_returned_subtree():
+    a = BlockAllocator(num_blocks=5, block_size=8)
+    b1, b2, b3 = a.alloc(), a.alloc(), a.alloc()
+    for b in (b1, b2, b3):
+        a.register(b)
+        a.release(b)
+    a.on_evict = lambda bid: [b2, b3] if bid == b1 else []
+    # exhaust the free list, then force one eviction
+    a.alloc()
+    victim = a.alloc()
+    assert victim == b1
+    # b2/b3 were dropped alongside b1: back on the free list, unregistered
+    assert a.cached_blocks == 0
+    assert a.free_blocks == 2
+    assert not a.is_registered(b2) and not a.is_registered(b3)
+    assert a.evictions == 3
+
+
+def test_eviction_hook_skips_blocks_held_by_active_requests():
+    a = BlockAllocator(num_blocks=4, block_size=8)
+    b1, b2 = a.alloc(), a.alloc()
+    a.register(b1)
+    a.register(b2)
+    a.release(b1)  # parked; b2 stays active
+    a.on_evict = lambda bid: [b2]
+    a.alloc()  # takes the last free block
+    got = a.alloc()  # evicts b1; hook names b2 but it has an active ref
+    assert got == b1
+    assert a.refcount(b2) == 1  # untouched
+    assert not a.is_registered(b2)  # mapping is gone though
+
+
+def test_unregister_frees_a_parked_block():
+    a = BlockAllocator(num_blocks=3, block_size=8)
+    b = a.alloc()
+    a.register(b)
+    a.release(b)
+    assert a.cached_blocks == 1
+    a.unregister(b)
+    assert a.cached_blocks == 0
+    assert a.free_blocks == 2
+
+
+def test_cow_sole_unregistered_owner_writes_in_place():
+    a = BlockAllocator(num_blocks=3, block_size=8)
+    b = a.alloc()
+    assert a.writable(b)
+    assert a.copy_on_write(b) == (b, False)
+    assert a.cow_copies == 0
+
+
+def test_cow_shared_block_moves_to_private_copy():
+    a = BlockAllocator(num_blocks=3, block_size=8)
+    b = a.alloc()
+    a.incref(b)  # second request shares it
+    assert not a.writable(b)
+    new, copied = a.copy_on_write(b)
+    assert copied and new != b
+    assert a.refcount(b) == 1  # our ref moved off
+    assert a.refcount(new) == 1
+    assert a.cow_copies == 1
+
+
+def test_cow_registered_block_moves_even_at_ref_one():
+    a = BlockAllocator(num_blocks=3, block_size=8)
+    b = a.alloc()
+    a.register(b)  # the index maps its contents: in-place write would
+    assert not a.writable(b)  # corrupt future prefix hits
+    new, copied = a.copy_on_write(b)
+    assert copied and new != b
+    assert a.cached_blocks == 1  # original parked, contents preserved
+
+
+def test_cow_pool_exhaustion_returns_none():
+    a = BlockAllocator(num_blocks=3, block_size=8)
+    b1 = a.alloc()
+    a.alloc()
+    a.incref(b1)
+    assert a.copy_on_write(b1) == (None, False)
+    assert a.refcount(b1) == 2  # caller's ref untouched on failure
+
+
+def test_stats_and_utilization():
+    a = BlockAllocator(num_blocks=5, block_size=8)
+    b1 = a.alloc()
+    a.alloc()
+    a.register(b1)
+    a.release(b1)
+    s = a.stats()
+    assert s["active_blocks"] == 1
+    assert s["cached_blocks"] == 1
+    assert s["free_blocks"] == 2
+    assert s["block_utilization"] == pytest.approx(1 / 4)
+    assert a.available() == 3
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        BlockAllocator(num_blocks=1, block_size=8)
+    with pytest.raises(ValueError):
+        BlockAllocator(num_blocks=4, block_size=0)
+
+
+# ---------------------------------------------------------------------------
+# RadixPrefixIndex
+# ---------------------------------------------------------------------------
+
+
+def _pool(n=32, bs=4):
+    a = BlockAllocator(num_blocks=n, block_size=bs)
+    return a, RadixPrefixIndex(a)
+
+
+def test_match_on_empty_index():
+    _, idx = _pool()
+    assert idx.match([1, 2, 3]) == (0, [])
+
+
+def test_insert_then_full_match():
+    a, idx = _pool(bs=4)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    blocks = [a.alloc(), a.alloc()]
+    assert idx.insert(toks, blocks) == 2
+    for b in blocks:
+        assert a.is_registered(b)
+    matched, got = idx.match(toks + [9, 9])
+    assert matched == 8
+    assert got == blocks
+
+
+def test_partial_within_block_match():
+    a, idx = _pool(bs=4)
+    blocks = [a.alloc(), a.alloc()]
+    idx.insert([1, 2, 3, 4, 5, 6, 7, 8], blocks)
+    # diverges inside the second block: token-granular match, the caller
+    # shares the block's leading rows and COWs before writing
+    matched, got = idx.match([1, 2, 3, 4, 5, 6, 99, 99])
+    assert matched == 6
+    assert got == blocks
+
+
+def test_partial_leaf_match_stops_the_walk():
+    a, idx = _pool(bs=4)
+    b1, b2 = a.alloc(), a.alloc()
+    idx.insert([1, 2, 3, 4, 5, 6], [b1, b2])  # second block partial (2 toks)
+    matched, got = idx.match([1, 2, 3, 4, 5, 6, 7, 8])
+    assert matched == 6
+    assert got == [b1, b2]
+
+
+def test_leaf_upgrade_replaces_partial_with_fuller_block():
+    a, idx = _pool(bs=4)
+    b1, b2 = a.alloc(), a.alloc()
+    idx.insert([1, 2, 3, 4, 5, 6], [b1, b2])
+    a.release(b2)  # parked
+    b3 = a.alloc()  # a later request materialized rows 4..7 fully
+    idx.insert([1, 2, 3, 4, 5, 6, 7, 8], [b1, b3])
+    assert not a.is_registered(b2)  # superseded leaf freed
+    matched, got = idx.match([1, 2, 3, 4, 5, 6, 7, 8])
+    assert matched == 8
+    assert got == [b1, b3]
+
+
+def test_insert_reuses_existing_nodes():
+    a, idx = _pool(bs=4)
+    b1, b2 = a.alloc(), a.alloc()
+    idx.insert([1, 2, 3, 4], [b1])
+    assert idx.insert([1, 2, 3, 4, 5, 6, 7, 8], [b1, b2]) == 1  # only b2 new
+    assert idx.num_nodes == 2
+
+
+def test_eviction_drops_whole_subtree():
+    a, idx = _pool(n=4, bs=4)  # 3 usable blocks
+    b1, b2, b3 = a.alloc(), a.alloc(), a.alloc()
+    idx.insert([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12], [b1, b2, b3])
+    for b in (b1, b2, b3):
+        a.release(b)
+    assert a.cached_blocks == 3
+    got = a.alloc()  # evicts b1 (LRU) -> its whole chain is unreachable
+    assert got == b1
+    assert idx.num_nodes == 0
+    assert a.cached_blocks == 0
+    assert idx.match([1, 2, 3, 4]) == (0, [])
+
+
+def test_hit_rate_counts_matched_tokens():
+    a, idx = _pool(bs=4)
+    idx.insert([1, 2, 3, 4], [a.alloc()])
+    idx.match([1, 2, 3, 4])      # 4/4
+    idx.match([9, 9, 9, 9])      # 0/4
+    assert idx.hit_rate() == pytest.approx(0.5)

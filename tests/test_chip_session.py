@@ -321,7 +321,9 @@ def test_chipbench_time_fn_consumes_all_grad_outputs():
             carry, _ = jax.lax.scan(body, a[0], None, length=4)
             return carry
 
-        return jax.jit(chained).lower(h, w).compile().cost_analysis()["flops"]
+        from neuronx_distributed_llama3_2_tpu.utils import compat
+
+        return compat.cost_analysis(jax.jit(chained).lower(h, w).compile())["flops"]
 
     both = cost_of(jax.grad(loss, argnums=(0, 1)))
     just_h = cost_of(jax.grad(loss, argnums=(0,)))
