@@ -1,0 +1,26 @@
+"""Static analysis for sharding/trace safety (shardlint).
+
+The analyzer is pure-AST: it never imports the modules it checks, so it
+runs on any host (no TPU, no jax initialization) and in CI as a plain
+pytest. See docs/static_analysis.md for the rule catalogue.
+"""
+
+from neuronx_distributed_llama3_2_tpu.analysis.shardlint import (
+    AxisEnv,
+    Finding,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+    load_axis_env,
+)
+
+__all__ = [
+    "AxisEnv",
+    "Finding",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "load_axis_env",
+]

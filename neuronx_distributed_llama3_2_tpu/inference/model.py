@@ -106,6 +106,13 @@ class LlamaDecode:
 
     config: LlamaConfig
 
+    # trace layout depends on global parallel state (shardlint SL002); valid
+    # across re-init only because initialize/destroy_model_parallel clear
+    # the jit cache (parallel/state.py)
+    __layout_deps__ = (
+        "model_parallel_is_initialized", "get_parallel_state",
+    )
+
     def _model(self) -> LlamaForCausalLM:
         return LlamaForCausalLM(self.config)
 
@@ -472,6 +479,11 @@ class MixtralDecode(LlamaDecode):
     identical to the training model's. Expert parallelism is not supported
     in decode (the reference's Mixtral inference is TP-only as well).
     """
+
+    # shardlint SL002 — see LlamaDecode; additionally branches on ep size
+    __layout_deps__ = LlamaDecode.__layout_deps__ + (
+        "get_expert_model_parallel_size",
+    )
 
     def _mlp_block(self, lp: Params, h: jax.Array) -> jax.Array:
         from neuronx_distributed_llama3_2_tpu.moe.model import MoE

@@ -80,16 +80,37 @@ def _iter_targets(params: Params, patterns) -> Dict[str, jax.Array]:
     }
 
 
-# path markers declaring a TWO-leading-stack-dim parameter layout. Today's
-# only registrant is mllama's grouped text stack (models/mllama.py
-# text_group_pattern packs plain layers as (G, k-1, ...)); a future model
-# introducing another grouped layout adds its marker here rather than
-# teaching _split_shape its naming ad hoc.
-TWO_STACK_PATH_MARKERS = ("layers/plain/",)
+# Grouped-stack registry: path marker -> regex of the plain 2-D kernel
+# names that layout lifts to rank 4 (the only rank-4 shapes a two-stack
+# split may interpret). The model module that *introduces* a grouped
+# layout registers it (models/mllama.py registers "layers/plain/" next to
+# text_group_pattern, the code that packs the (G, k-1, ...) stack) — the
+# naming knowledge lives with the layout's author instead of an allowlist
+# here going stale.
+_GROUPED_STACK_LAYOUTS: Dict[str, "re.Pattern[str]"] = {}
 
-# plain 2-D kernels a grouped stack lifts to rank 4 — the only rank-4
-# shapes a two-stack split may interpret
-_PLAIN_2D_KERNEL = re.compile(r"(q_kernel|k_kernel|v_kernel|/kernel)$")
+
+def register_grouped_stack(path_marker: str, kernel_patterns) -> None:
+    """Declare a parameter layout carrying TWO leading stack dims.
+
+    ``path_marker``: substring of the '/'-joined param path identifying the
+    layout (shape alone is ambiguous with single-stack fused kernels).
+    ``kernel_patterns``: regexes naming the plain 2-D kernels the layout
+    stacks; any other rank-4 leaf under the marker is rejected as
+    ambiguous. Idempotent per marker so module re-imports don't double up.
+    """
+    _GROUPED_STACK_LAYOUTS[path_marker] = re.compile(
+        "|".join(f"(?:{p})" for p in kernel_patterns)
+    )
+
+
+def _grouped_kernel_re(path: str):
+    """The registered kernel regex whose marker matches ``path``, else
+    None (single-stack layout)."""
+    for marker, kernel_re in _GROUPED_STACK_LAYOUTS.items():
+        if marker in path:
+            return kernel_re
+    return None
 
 
 def _split_shape(shape, path: str = "") -> Tuple[Tuple[int, ...], int, Tuple[int, ...]]:
@@ -97,15 +118,16 @@ def _split_shape(shape, path: str = "") -> Tuple[Tuple[int, ...], int, Tuple[int
 
     Kernels here are (in, out...) possibly with leading layer-stack dims:
     (in, out) [incl. embeddings, reference LoraEmbedding layer.py:245],
-    (L, in, out), (L, in, t, out) [fused gate_up]. Mllama's grouped text
-    layout carries TWO stack dims on the plain-layer stack — (G, k-1, ...)
-    under a ``layers/plain/`` path (models/mllama.py text_group_pattern),
-    identified by path since shape alone is ambiguous with fused gate_up.
+    (L, in, out), (L, in, t, out) [fused gate_up]. Grouped layouts carry
+    TWO stack dims — e.g. mllama's plain-layer stack (G, k-1, ...) under a
+    ``layers/plain/`` path — identified via the register_grouped_stack
+    registry, since shape alone is ambiguous with fused gate_up.
     MoE expert weights also carry two stack dims but in a layout the split
     would misread — LoraModel refuses expert paths at construction (the
     reference doesn't LoRA experts either); the rank guard backstops
     unknown layouts."""
-    n_stack = 2 if any(m in path for m in TWO_STACK_PATH_MARKERS) else 1
+    grouped_re = _grouped_kernel_re(path)
+    n_stack = 2 if grouped_re is not None else 1
     if len(shape) > 3 + n_stack:
         raise ValueError(
             f"kernel rank {len(shape)} is not LoRA-targetable; exclude it "
@@ -123,7 +145,7 @@ def _split_shape(shape, path: str = "") -> Tuple[Tuple[int, ...], int, Tuple[int
         )
     if len(shape) == 3 or n_stack == 1:
         return (shape[0],), shape[1], tuple(shape[2:])
-    if len(shape) == 4 and not _PLAIN_2D_KERNEL.search(path):
+    if len(shape) == 4 and not grouped_re.search(path):
         # a rank-4 leaf under a grouped stack that is NOT a plain 2-D
         # kernel is shape-ambiguous (could be a single-stack fused
         # (L, in, t, out)) — refuse loudly rather than mis-split

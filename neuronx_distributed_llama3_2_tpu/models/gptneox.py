@@ -179,6 +179,9 @@ class GPTNeoXMLP:
     """h → I → h with gelu and optional biases (HF GPTNeoXMLP / CodeGenMLP)."""
 
     config: GPTNeoXConfig
+    # trace layout depends on global parallel state (shardlint SL002); safe
+    # because initialize/destroy_model_parallel clear the jit cache
+    __layout_deps__ = ("sequence_parallel_enabled",)
 
     def _up(self) -> ColumnParallelLinear:
         c = self.config

@@ -345,6 +345,13 @@ class LlamaAttention:
     attention, row-parallel output projection with SP reduce-scatter."""
 
     config: LlamaConfig
+    # trace layout depends on global parallel state (shardlint SL002); valid
+    # across re-init only because initialize/destroy_model_parallel clear
+    # the jit cache (parallel/state.py)
+    __layout_deps__ = (
+        "get_context_parallel_size", "get_parallel_state",
+        "model_parallel_is_initialized", "sequence_parallel_enabled",
+    )
 
     def _qkv(self) -> GQAQKVColumnParallelLinear:
         c = self.config
@@ -489,6 +496,8 @@ class LlamaMLP:
     contracts it as a single (H, 2I) matmul on the MXU."""
 
     config: LlamaConfig
+    # shardlint SL002 — see LlamaAttention
+    __layout_deps__ = ("sequence_parallel_enabled",)
 
     def _down(self) -> RowParallelLinear:
         c = self.config
@@ -596,6 +605,11 @@ class LlamaForCausalLM:
     replicated (reference parallel_cross_entropy usage :643)."""
 
     config: LlamaConfig
+    # shardlint SL002 — see LlamaAttention
+    __layout_deps__ = (
+        "get_context_parallel_size", "model_parallel_is_initialized",
+        "sequence_parallel_enabled",
+    )
 
     def _embed(self) -> ParallelEmbedding:
         c = self.config

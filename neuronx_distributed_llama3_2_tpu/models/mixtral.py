@@ -131,6 +131,8 @@ class MixtralForCausalLM:
     ``router_aux_loss_coef · mean(per-layer aux)``."""
 
     config: MixtralConfig
+    # shardlint SL002 — see models/llama.py LlamaAttention
+    __layout_deps__ = ("sequence_parallel_enabled",)
 
     def _llama(self) -> LlamaForCausalLM:
         # reuse embed/lm-head/final-norm/logits/loss-tail machinery

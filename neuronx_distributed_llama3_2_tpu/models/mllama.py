@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from neuronx_distributed_llama3_2_tpu.lora import model as lora_model
 from neuronx_distributed_llama3_2_tpu.models.llama import (
     LlamaConfig,
     LlamaDecoderLayer,
@@ -333,6 +334,16 @@ def text_group_pattern(t: "MllamaTextConfig"):
     if xpos >= k or xl != tuple(xpos + g * k for g in range(G)):
         return None
     return G, k, xpos
+
+
+# the grouped text stack lifts the plain layers' 2-D kernels to
+# (G, k-1, in, out); declare which kernel names those are so the LoRA
+# split can tell them from single-stack fused (L, in, t, out) kernels —
+# the registry keeps this naming next to the code that packs the stack
+# (_pack_text_layers below) instead of an allowlist in lora/model.py
+lora_model.register_grouped_stack(
+    "layers/plain/", (r"q_kernel$", r"k_kernel$", r"v_kernel$", r"/kernel$")
+)
 
 
 def _pack_text_layers(layer_list, pattern):
@@ -845,6 +856,8 @@ class MllamaForConditionalGeneration:
     (init/specs/__call__/loss) so trainer/checkpoint layers apply."""
 
     config: MllamaConfig
+    # shardlint SL002 — see models/llama.py LlamaAttention
+    __layout_deps__ = ("sequence_parallel_enabled", "tensor_parallel_size_or")
 
     def _self_layer(self) -> LlamaDecoderLayer:
         return LlamaDecoderLayer(self.config.text.self_attn_layer_config())

@@ -75,6 +75,13 @@ class MoE:
     ``(y (B,S,H), router_logits (T,E), expert_idx (T,k))``."""
 
     config: MoEConfig
+    # trace layout depends on global parallel state (shardlint SL002); valid
+    # across re-init only because initialize/destroy_model_parallel clear
+    # the jit cache (parallel/state.py)
+    __layout_deps__ = (
+        "get_expert_model_parallel_size", "get_parallel_state",
+        "model_parallel_is_initialized",
+    )
 
     def _router(self) -> Router:
         c = self.config

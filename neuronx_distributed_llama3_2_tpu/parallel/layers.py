@@ -263,6 +263,9 @@ class GQAQKVColumnParallelLinear:
     # and __call__ can't disagree because re-initializing the mesh requires
     # destroy_model_parallel() + re-placing the params anyway.
     tensor_parallel_size: Optional[int] = None
+    # shardlint SL002: the lazy _tp() lookup above reads the live parallel
+    # state, so the traced layout depends on it
+    __layout_deps__ = ("tensor_parallel_size_or",)
 
     def _tp(self) -> int:
         if self.tensor_parallel_size is not None:

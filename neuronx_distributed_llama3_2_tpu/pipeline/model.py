@@ -100,6 +100,8 @@ class PipelinedCausalLM:
 
     model: LlamaForCausalLM
     num_microbatches: int
+    # shardlint SL002 — see models/llama.py LlamaAttention
+    __layout_deps__ = ("get_parallel_state", "get_pipeline_model_parallel_size")
     # "gpipe": fwd scan + autodiff backward — O(M) stashed stage-streams,
     #   lowest bubble (M/(M+pp-1) utilization).
     # "1f1b": single scan doing one fwd + one manual-VJP bwd stage-apply per

@@ -122,3 +122,11 @@ def set_cpu_devices(n: int) -> None:
         ]
         flags.append(f"--xla_force_host_platform_device_count={n}")
         os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def is_legacy_jax() -> bool:
+    """True on the jax 0.4.x line (legacy SPMD partitioner, list-valued
+    cost_analysis, no ``jax.shard_map``). Keyed on the same probe the
+    shims use — the presence of ``jax.shard_map`` — rather than a version
+    string parse, so prereleases and vendor forks classify correctly."""
+    return getattr(jax, "shard_map", None) is None

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""shardlint CI gate: lint the repo's own sources, fail on new findings.
+
+Usage:
+    python scripts/shardlint_gate.py --self            # lint the repo
+    python scripts/shardlint_gate.py path/to/file.py   # lint specific paths
+    python scripts/shardlint_gate.py --self --write-baseline
+    python scripts/shardlint_gate.py --rules           # print the catalogue
+
+``--self`` lints the package, ``scripts/`` and ``tests/``. Exit status is
+nonzero iff a finding is NOT in the baseline file — so grandfathered
+findings don't block CI but every new one does. The baseline records
+line-number-independent fingerprints (rule + path + normalized source
+text), so unrelated edits above a baselined finding don't resurrect it.
+
+Baselining a finding is an explicit, reviewed act: run with
+``--write-baseline`` and commit the updated file with a rationale.
+
+The tier-1 suite runs this gate as
+``tests/test_shardlint.py::test_self_lint`` — no separate CI plumbing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from neuronx_distributed_llama3_2_tpu.analysis import (  # noqa: E402
+    RULES,
+    lint_paths,
+    load_axis_env,
+)
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "scripts", "shardlint_baseline.txt")
+
+# what --self lints: every layer that touches meshes, collectives or
+# traces, plus the analyzer itself (it must stay clean under its own gate)
+SELF_PATHS = ("neuronx_distributed_llama3_2_tpu", "scripts", "tests")
+
+
+def read_baseline(path: str) -> dict:
+    """fingerprint -> raw line (comments/blank lines skipped)."""
+    out = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, "r") as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            # format: <RULE> <relpath> <fingerprint> [# rationale]
+            if len(parts) >= 3:
+                out[parts[2]] = line
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument(
+        "--self", action="store_true", dest="self_lint",
+        help="lint the repo's own sources (package + scripts + tests)",
+    )
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline to accept all current findings",
+    )
+    ap.add_argument(
+        "--rules", action="store_true", help="print the rule catalogue"
+    )
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rule, summary in sorted(RULES.items()):
+            print(f"{rule}  {summary}")
+        return 0
+
+    paths = list(args.paths)
+    if args.self_lint:
+        paths.extend(os.path.join(REPO_ROOT, p) for p in SELF_PATHS)
+    if not paths:
+        ap.error("no paths given (use --self to lint the repo)")
+
+    findings = lint_paths(
+        paths, repo_root=REPO_ROOT, axis_env=load_axis_env(REPO_ROOT)
+    )
+
+    if args.write_baseline:
+        with open(args.baseline, "w") as fh:
+            fh.write(
+                "# shardlint baseline: grandfathered findings (fingerprint-"
+                "keyed, line-move-proof).\n# Regenerate with: python "
+                "scripts/shardlint_gate.py --self --write-baseline\n"
+                "# Every entry needs a rationale; prefer fixing over "
+                "baselining.\n"
+            )
+            for f in findings:
+                fh.write(f"{f.rule} {f.path} {f.fingerprint}\n")
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = read_baseline(args.baseline)
+    new = [f for f in findings if f.fingerprint not in baseline]
+    old = len(findings) - len(new)
+
+    for f in new:
+        print(f.format())
+    if old:
+        print(f"{old} baselined finding(s) suppressed ({args.baseline})")
+    if new:
+        print(
+            f"shardlint: {len(new)} new finding(s). Fix them, add a line "
+            "suppression (# shardlint: disable=SL00x), or baseline with "
+            "--write-baseline and a commit rationale."
+        )
+        return 1
+    print(f"shardlint: clean ({len(findings)} total, {old} baselined)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

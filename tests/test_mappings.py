@@ -85,7 +85,7 @@ def test_gather_sp_gradient_is_reduce_scatter():
             return (full**2).sum()
 
         return _shard_map(
-            lambda x: jax.lax.psum(body(x), "tp") / 4.0, mesh, P(), P()
+            lambda x: jax.lax.psum(body(x), ps.TP_AXIS) / 4.0, mesh, P(), P()
         )(x)
 
     g = jax.grad(loss)(x)
