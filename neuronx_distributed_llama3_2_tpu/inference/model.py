@@ -403,14 +403,48 @@ class LlamaDecode:
                 kv_limit if kv_limit is not None
                 else block_tables.shape[1] * bs
             )
-            jlog = jnp.arange(limit, dtype=jnp.int32)
-            rd_phys = block_tables[:, jlog // bs] * bs + (jlog % bs)[None, :]
-            k_all = kflat[rd_phys].astype(q.dtype)  # (b, limit, NKV, D)
-            v_all = vflat[rd_phys].astype(q.dtype)
-            att = self._cache_attention(
-                q, k_all, v_all, pos_block, ha, positions=positions, tree=tree
-            )
+            if self._paged_kernel_eligible(q.shape[1], tree):
+                # gather-free read: the kernel dereferences the block table
+                # inside its BlockSpec index maps, so the (b, limit, NKV, D)
+                # K/V copy below never materializes (flash-decoding split-K,
+                # kernels/paged_attention_pallas)
+                from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (
+                    paged_flash_decode,
+                )
+
+                att = paged_flash_decode(
+                    q[:, 0], kc, vc, block_tables, pos_block[:, 0],
+                    kv_limit=limit,
+                )[:, None]
+                att = constrain(att, P(BATCH_AXES, None, ha, None))
+            else:
+                jlog = jnp.arange(limit, dtype=jnp.int32)
+                rd_phys = block_tables[:, jlog // bs] * bs + (jlog % bs)[None, :]
+                k_all = kflat[rd_phys].astype(q.dtype)  # (b, limit, NKV, D)
+                v_all = vflat[rd_phys].astype(q.dtype)
+                att = self._cache_attention(
+                    q, k_all, v_all, pos_block, ha, positions=positions,
+                    tree=tree,
+                )
         return att, kc, vc
+
+    def _paged_kernel_eligible(self, t: int, tree) -> bool:
+        """Gate for the Pallas paged-decode kernel: the ``use_paged_kernel``
+        config opt-in, T == 1 token-gen only (suffix prefill and tree
+        verification keep the dense gather — their fresh block attends many
+        rows at once), and no multi-device mesh (``pallas_call`` is opaque to
+        the SPMD partitioner, so under tp the gather path's sharded einsums
+        stay the right choice)."""
+        from neuronx_distributed_llama3_2_tpu.parallel import (
+            state as parallel_state,
+        )
+
+        if not self.config.use_paged_kernel or t != 1 or tree is not None:
+            return False
+        if parallel_state.model_parallel_is_initialized():
+            if parallel_state.get_parallel_state().mesh.size > 1:
+                return False
+        return True
 
     def _mlp_block(self, lp: Params, h: jax.Array) -> jax.Array:
         """Post-attention feed-forward on the normed hidden (b,T,H).

@@ -1,3 +1,6 @@
 from neuronx_distributed_llama3_2_tpu.kernels.flash_attention import (  # noqa: F401
     flash_attention,
 )
+from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (  # noqa: F401
+    paged_flash_decode,
+)

@@ -1,0 +1,225 @@
+"""Pallas TPU paged-attention decode kernel (flash-decoding over block tables).
+
+The serving decode path reads the KV pool through a per-request block table.
+The jnp fallback (``LlamaDecode._attend_paged``) materializes the gather —
+``kflat[rd_phys]`` builds a dense ``(b, kv_limit, NKV, D)`` K/V copy in HBM
+every decode step before a masked-softmax einsum, doubling the cache read
+traffic of the step that is already cache-bandwidth-bound. This kernel
+removes the copy: the block table rides in as a *scalar-prefetch* operand,
+and the K/V BlockSpec index maps dereference it, so Mosaic DMAs each pool
+block straight from its pooled location into VMEM (vLLM PagedAttention's
+gather-free read, done TPU-style through ``PrefetchScalarGridSpec``).
+
+Structure (flash-decoding, Dao et al. 2023 — split-K for a single query row):
+
+- grid ``(b, NKV, num_splits, blocks_per_split)``: one program instance per
+  (lane, kv head); the kv-length dimension is partitioned into
+  ``num_splits`` independent chunks so long contexts expose parallelism
+  beyond the (tiny) decode batch.
+- within a split, the per-block online softmax carries the running max ``m``,
+  denominator ``l`` and unnormalized accumulator in VMEM scratch — exactly
+  the ``_fwd_kernel`` recurrence of :mod:`.pallas_flash_attention`.
+- each split emits ``(acc, m, l)``; the final combine outside the kernel
+  rescales by ``exp(m_s - m*)`` (log-sum-exp merge) and normalizes once.
+- GQA is grouped: q arrives as ``(b, NKV, G, D)`` and each program attends
+  its G query heads against one shared kv head — no KV replication.
+- masking is per-lane by position (``row <= positions[lane]``), which also
+  kills null-block garbage rows: the engine guarantees every row past a
+  request's frontier is masked, whatever stale block the table points at.
+
+Interpret mode (`jax.default_backend() != "tpu"`) runs the same kernel body
+through the Pallas interpreter so the tier-1 CPU suite exercises this exact
+code path; the real-chip numerics gate lives in scripts/tpu_kernel_gate.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from neuronx_distributed_llama3_2_tpu.kernels.pallas_flash_attention import (
+    NEG_INF,
+    _interpret,
+)
+from neuronx_distributed_llama3_2_tpu.utils import compat
+
+# kv-length split count: enough to keep a megacore busy past small decode
+# batches without shrinking per-split work below a few blocks
+DEFAULT_NUM_SPLITS = 4
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _decode_kernel(
+    tbl_ref,   # scalar prefetch: (b, W) int32 block table (SMEM)
+    pos_ref,   # scalar prefetch: (b,) int32 query positions (SMEM)
+    q_ref,     # (G, D) — this lane/kv-head's query group
+    k_ref,     # (bs, D) — one pool block, fetched through the table
+    v_ref,     # (bs, D)
+    o_ref,     # (G, D) f32 — per-split UNNORMALIZED accumulator
+    m_ref,     # (G, 1) f32 — per-split running max
+    l_ref,     # (G, 1) f32 — per-split denominator
+    m_scr, l_scr, acc_scr,
+    *, bs: int, bps: int, nblk: int, sm_scale: float,
+):
+    i = pl.program_id(0)          # lane
+    s = pl.program_id(2)          # kv split
+    j = pl.program_id(3)          # block within split
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    lb = s * bps + j              # logical block index into the sequence
+    pos = pos_ref[i]
+    # skip padding blocks past kv_limit and blocks entirely beyond this
+    # lane's position (the frontier: row pos itself was just written)
+    run = (lb < nblk) & (lb * bs <= pos)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[:]                               # (G, D)
+        k = k_ref[:].astype(q.dtype)               # (bs, D)
+        sc = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                               # (G, bs) fp32
+        rows = lb * bs + lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+        mask = rows <= pos
+        sc = jnp.where(mask, sc, NEG_INF)
+
+        m_prev = m_scr[:, 0]
+        # `run` guarantees >= 1 valid row, so m_new is finite here
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1))
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.exp(sc - m_new[:, None])
+        p = jnp.where(mask, p, 0.0)
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        v = v_ref[:].astype(q.dtype)               # (bs, D)
+        pv = lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                          # (G, D)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + pv
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = l_new
+
+    @pl.when(j == bps - 1)
+    def _finalize():
+        # emit the split's raw (acc, m, l); the LSE combine happens outside
+        o_ref[:] = acc_scr[:]
+        m_ref[:] = m_scr[:]
+        l_ref[:] = l_scr[:]
+
+
+def paged_flash_decode(
+    q: jax.Array,             # (b, N, D) — one query token per lane
+    k_pool: jax.Array,        # (num_blocks, bs, NKV, D) pool slice
+    v_pool: jax.Array,        # (num_blocks, bs, NKV, D)
+    block_tables: jax.Array,  # (b, W) int32; entries must be < num_blocks
+    positions: jax.Array,     # (b,) int32 — row of the just-written query
+    *,
+    kv_limit: int | None = None,
+    num_splits: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Gather-free paged decode attention; returns (b, N, D) in q.dtype.
+
+    Logical row ``p`` of lane ``i`` lives at pool row
+    ``block_tables[i, p // bs] * bs + p % bs``; rows ``<= positions[i]`` are
+    attended, everything else (padding, null-block garbage) is masked.
+    ``kv_limit`` (static) bounds the logical rows visited, exactly like the
+    dense path. The caller guarantees ``positions[i] < kv_limit``.
+    """
+    b, n, d = q.shape
+    nb, bs, nkv, _ = k_pool.shape
+    if n % nkv:
+        raise ValueError(f"q heads ({n}) must be a multiple of kv heads ({nkv})")
+    g = n // nkv
+    w = block_tables.shape[1]
+    limit = kv_limit if kv_limit is not None else w * bs
+    nblk = _ceil_div(limit, bs)
+    if nblk > w:
+        raise ValueError(f"kv_limit {limit} exceeds table capacity {w * bs}")
+    splits = num_splits if num_splits is not None else DEFAULT_NUM_SPLITS
+    splits = max(1, min(splits, nblk))
+    bps = _ceil_div(nblk, splits)
+    sm_scale = d ** -0.5
+
+    qg = q.reshape(b, nkv, g, d)
+    grid = (b, nkv, splits, bps)
+
+    def q_idx(i, h, s, j, tbl, pos):
+        return (i, h, 0, 0)
+
+    def kv_idx(i, h, s, j, tbl, pos):
+        # the gather-free read: the table entry IS the pool block index the
+        # pipeline DMAs next; clamp covers split padding (those iterations
+        # are predicated off in the kernel body)
+        lb = jnp.minimum(s * bps + j, nblk - 1)
+        return (tbl[i, lb], 0, h, 0)
+
+    def out_idx(i, h, s, j, tbl, pos):
+        return (i, h, s, 0, 0)
+
+    kernel = functools.partial(
+        _decode_kernel, bs=bs, bps=bps, nblk=nblk, sm_scale=sm_scale
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, g, d), q_idx),
+            pl.BlockSpec((None, bs, None, d), kv_idx),
+            pl.BlockSpec((None, bs, None, d), kv_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, None, g, d), out_idx),
+            # trailing singleton keeps the last-two-dims tiling legal
+            pl.BlockSpec((None, None, None, g, 1), out_idx),
+            pl.BlockSpec((None, None, None, g, 1), out_idx),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    o_parts, m_parts, l_parts = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nkv, splits, g, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, splits, g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, splits, g, 1), jnp.float32),
+        ],
+        # lane/head/split all carry independent scratch epochs (re-inited at
+        # j == 0); only the innermost block dim is a true reduction
+        compiler_params=compat.tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        ),
+        interpret=_interpret() if interpret is None else interpret,
+    )(
+        block_tables.astype(jnp.int32), positions.astype(jnp.int32),
+        qg, k_pool, v_pool,
+    )
+
+    # flash-decoding combine: merge the per-split partial softmaxes by
+    # rescaling each to the global max (log-sum-exp), then normalize once.
+    m_star = jnp.max(m_parts, axis=2, keepdims=True)       # (b,NKV,1,G,1)
+    weight = jnp.where(
+        m_parts == NEG_INF, 0.0, jnp.exp(m_parts - m_star)
+    )                                                      # (b,NKV,S,G,1)
+    l_tot = jnp.sum(weight * l_parts, axis=2)              # (b,NKV,G,1)
+    acc = jnp.sum(weight * o_parts, axis=2)                # (b,NKV,G,D)
+    out = acc / jnp.where(l_tot == 0.0, 1.0, l_tot)
+    return out.reshape(b, n, d).astype(q.dtype)

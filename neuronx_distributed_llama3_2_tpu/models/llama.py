@@ -85,6 +85,11 @@ class LlamaConfig:
     # flash kernel tile sizes (perf knobs; defaults in kernels/)
     flash_block_q: Optional[int] = None
     flash_block_kv: Optional[int] = None
+    # paged serving decode: read the KV pool through the block table with
+    # the Pallas flash-decoding kernel (kernels/paged_attention_pallas)
+    # instead of materializing a (b, kv_limit, NKV, D) gather; applies to
+    # T == 1 token-gen only, dense gather remains the fallback
+    use_paged_kernel: bool = False
     # chunk the LM head + CE over the sequence so full (B,S,V) logits never
     # materialize; None disables (loss-memory redesign, no reference analogue)
     loss_chunk_size: Optional[int] = None

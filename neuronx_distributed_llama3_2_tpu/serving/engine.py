@@ -21,6 +21,10 @@ active lane) but replaces the memory model underneath:
   youngest request is preempted and requeued (its registered prefix blocks
   park in the cached LRU, so resumption usually re-admits by reference) —
   never an exception out of :meth:`step`.
+- With ``PagedConfig.prefill_chunk_tokens`` set, a long uncached suffix is
+  prefilled in fixed-token chunks, one per :meth:`step`, interleaved with
+  the decode batch for already-active lanes (Sarathi-Serve chunked
+  prefill) — only the final chunk samples the request's first token.
 
 Greedy outputs are token-identical to the dense engine: the paged gather
 feeds the same K/V values in the same logical order to the same
@@ -78,6 +82,12 @@ class PagedConfig:
     enable_prefix_caching: bool = True
     cache_dtype: Any = None
     metrics_log_every: int = 0  # decode steps between metric log lines; 0=off
+    # chunked prefill (Sarathi-Serve): split an admission whose uncached
+    # suffix exceeds this many tokens into fixed-budget chunks, one per
+    # step(), interleaved with decode batches for the already-active lanes —
+    # a long prompt no longer stalls every decode stream for its whole
+    # prefill. None/0 = off (whole-suffix prefill at admission, as before).
+    prefill_chunk_tokens: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -91,6 +101,12 @@ class _PagedRequest:
     cached_tokens: int = 0       # cumulative across (re-)admissions
     preemptions: int = 0
     done: bool = False
+    # chunked prefill: admitted (lane + blocks held) but still materializing
+    # the prompt one chunk per step; joins the decode batch only when
+    # prefill_pos reaches prefill_target (= len(prompt + out) at admission)
+    prefilling: bool = False
+    prefill_pos: int = 0
+    prefill_target: int = 0
 
 
 class PagedServingEngine:
@@ -150,6 +166,9 @@ class PagedServingEngine:
         self._queue: List[_PagedRequest] = []
         self._active: Dict[int, _PagedRequest] = {}  # lane -> request
         self._finished: Dict[int, _PagedRequest] = {}
+        # rid -> request, for O(1) request_info across every lifecycle state
+        # (queued / active / prefilling / preempted / finished)
+        self._requests: Dict[int, _PagedRequest] = {}
         self._free_lanes = list(range(engine.max_batch))
         self._key = jax.random.key(gen.seed)
         self._tokens = np.zeros((engine.max_batch,), np.int32)
@@ -287,7 +306,9 @@ class PagedServingEngine:
             )
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_PagedRequest(rid=rid, prompt=list(prompt), out=[]))
+        req = _PagedRequest(rid=rid, prompt=list(prompt), out=[])
+        self._queue.append(req)
+        self._requests[rid] = req
         self.metrics.submitted += 1
         return rid
 
@@ -308,6 +329,7 @@ class PagedServingEngine:
             n_shared_full = cached // bs
             need_new = (n_total - n_shared_full) + self.paged.decode_reserve_blocks
             if alloc.available() < need_new:
+                self.metrics.admit_blocked += 1
                 return  # FCFS head-of-line: wait for blocks to drain
             self._queue.pop(0)
             # take shared refs BEFORE allocating, so our own allocations
@@ -345,22 +367,38 @@ class PagedServingEngine:
                 self._queue.insert(0, req)
                 return
             lane = self._free_lanes.pop(0)
+            req.lane = lane
+            req.table = table
+            req.cached_tokens += cached
+            self._tables[lane, :] = NULL_BLOCK
+            self._active[lane] = req
+            self.metrics.admitted += 1
+            self.metrics.cached_tokens += cached
+            chunk = self.paged.prefill_chunk_tokens
+            if chunk and len(seq) - cached > chunk:
+                # chunked admission: the lane holds its blocks but joins the
+                # decode batch only after the final chunk. Until then the
+                # decode-visible table row stays all-null — the batched
+                # decode program scatter-writes K/V for EVERY lane, and a
+                # live table would let those garbage writes land in this
+                # request's real blocks mid-prefill. Prefix registration is
+                # deferred too: the blocks hold valid tokens only when the
+                # last chunk completes.
+                req.prefilling = True
+                req.prefill_pos = cached
+                req.prefill_target = len(seq)
+                self._tokens[lane] = 0
+                self._positions[lane] = 0
+                continue
             suffix = seq[cached:]
             self._key, k = jax.random.split(self._key)
             first = self._prefill(suffix, cached, table, k)
             req.out.append(first)
-            req.lane = lane
-            req.table = table
             req.position = len(seq)
-            req.cached_tokens += cached
             self._tokens[lane] = first
             self._positions[lane] = req.position
-            self._tables[lane, :] = NULL_BLOCK
             self._tables[lane, : len(table)] = table
-            self._active[lane] = req
-            self.metrics.admitted += 1
             self.metrics.prefill_tokens += len(suffix)
-            self.metrics.cached_tokens += cached
             if self.paged.enable_prefix_caching:
                 # register the prompt's full blocks immediately so requests
                 # admitted later in this same wave share them; the partial
@@ -396,6 +434,45 @@ class PagedServingEngine:
             )
         return int(np.asarray(jax.device_get(tok))[0])
 
+    def _advance_prefills(self) -> None:
+        """One fixed-budget chunk per prefilling lane per step (Sarathi-Serve
+        chunked prefill): each chunk runs through the existing suffix-prefill
+        program starting at ``prefill_pos``, so all non-final chunks of a
+        given chunk size reuse ONE compiled (bucket, kv_limit) family. The
+        sampled token is discarded on non-final chunks — only the final
+        chunk's logits are the real next-token distribution — and bucket
+        padding is safe for the same reason it always was: padded writes
+        land at rows a later chunk overwrites before any mask admits them."""
+        chunk = self.paged.prefill_chunk_tokens
+        bs = self.paged.block_size
+        for lane, req in list(self._active.items()):
+            if not req.prefilling:
+                continue
+            seq = req.prompt + req.out
+            start = req.prefill_pos
+            piece = seq[start: start + chunk]
+            final = start + len(piece) >= req.prefill_target
+            self._key, k = jax.random.split(self._key)
+            tok = self._prefill(piece, start, req.table, k)
+            req.prefill_pos = start + len(piece)
+            self.metrics.prefill_tokens += len(piece)
+            self.metrics.prefill_chunks += 1
+            if not final:
+                continue
+            # final chunk: sample the first token, install the real table
+            # into the decode batch, register the prompt for prefix sharing
+            req.prefilling = False
+            req.out.append(tok)
+            req.position = req.prefill_target
+            self._tokens[lane] = tok
+            self._positions[lane] = req.position
+            self._tables[lane, : len(req.table)] = req.table
+            if self.paged.enable_prefix_caching:
+                n_full = len(seq) // bs
+                if n_full:
+                    self.index.insert(seq[: n_full * bs], req.table[:n_full])
+            self._maybe_finish(req)
+
     def _preempt(self, req: _PagedRequest) -> None:
         """Pool exhausted: bump the request back to the queue head. Its
         registered prefix blocks park in the cached LRU, so re-admission
@@ -406,6 +483,11 @@ class PagedServingEngine:
         req.table = []
         req.lane = None
         req.position = 0
+        # a victim caught mid-chunked-prefill restarts its prefill from the
+        # (possibly re-matched) cached prefix on re-admission
+        req.prefilling = False
+        req.prefill_pos = 0
+        req.prefill_target = 0
         del self._active[lane]
         self._free_lanes.append(lane)
         self._tables[lane, :] = NULL_BLOCK
@@ -428,6 +510,8 @@ class PagedServingEngine:
             req = self._active.get(lane)
             if req is None:
                 continue  # preempted while servicing an older lane
+            if req.prefilling:
+                continue  # admission already allocated the whole-prompt table
             if req.position // bs < len(req.table):
                 continue
             while True:
@@ -474,18 +558,24 @@ class PagedServingEngine:
     # -- serving loop -------------------------------------------------------
 
     def step(self) -> bool:
-        """Admit waiting requests, advance every active lane one token.
+        """Admit waiting requests, push one prefill chunk per prefilling
+        lane, then advance every decode-ready lane one token — so a long
+        prompt's chunks interleave with the existing streams' decode steps.
         Pool exhaustion preempts-and-requeues instead of raising. Returns
         False when nothing is left to do."""
         self._admit()
-        if not self._active:
-            return bool(self._queue)
+        self._advance_prefills()
+        if not any(not r.prefilling for r in self._active.values()):
+            return bool(self._active or self._queue)
         self._ensure_decode_blocks()
-        if not self._active:
-            return bool(self._queue)  # everyone preempted; re-admit next step
+        decode_lanes = [
+            l for l, r in self._active.items() if not r.prefilling
+        ]
+        if not decode_lanes:
+            return bool(self._active or self._queue)  # re-admit next step
         eng = self.engine
         kv_limit = eng._kv_bucket(
-            int(max(self._positions[l] for l in self._active)) + 1
+            int(max(self._positions[l] for l in decode_lanes)) + 1
         )
         fn = self._decode_program(self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)
@@ -497,6 +587,8 @@ class PagedServingEngine:
         toks = np.asarray(jax.device_get(toks))
         self.metrics.decode_steps += 1
         for lane, req in list(self._active.items()):
+            if req.prefilling:
+                continue  # null-table lane: its sampled token is garbage
             req.out.append(int(toks[lane]))
             req.position += 1
             self._tokens[lane] = toks[lane]
@@ -516,17 +608,10 @@ class PagedServingEngine:
 
     def request_info(self, rid: int) -> dict:
         """Per-request serving stats (``cached_tokens`` is the per-request
-        prefix-cache report the protocol layer surfaces)."""
-        for pool in (self._finished, ):
-            if rid in pool:
-                req = pool[rid]
-                break
-        else:
-            req = next(
-                (r for r in list(self._active.values()) + self._queue
-                 if r.rid == rid),
-                None,
-            )
+        prefix-cache report the protocol layer surfaces). O(1): every
+        request lives in ``_requests`` from submit() on, whatever lifecycle
+        state it is in (queued / active / prefilling / preempted / finished)."""
+        req = self._requests.get(rid)
         if req is None:
             raise KeyError(f"unknown request id {rid}")
         return {
@@ -535,6 +620,7 @@ class PagedServingEngine:
             "generated_tokens": len(req.out),
             "cached_tokens": req.cached_tokens,
             "preemptions": req.preemptions,
+            "prefilling": req.prefilling,
             "done": req.done,
         }
 

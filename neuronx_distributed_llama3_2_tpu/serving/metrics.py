@@ -25,11 +25,13 @@ class ServingMetrics:
 
     submitted: int = 0
     admitted: int = 0
+    admit_blocked: int = 0    # admission waves deferred on the block budget
     finished: int = 0
     truncated: int = 0        # finished early because the pool can never fit
     preemptions: int = 0      # requests bumped back to the queue
     decode_steps: int = 0
     prefill_tokens: int = 0   # prompt tokens actually pushed through prefill
+    prefill_chunks: int = 0   # chunked-prefill program invocations
     cached_tokens: int = 0    # prompt tokens admitted by prefix reference
 
     def prefix_skip_fraction(self) -> float:

@@ -76,6 +76,10 @@ STAGES = [
      [PY, os.path.join(REPO, "scripts", "ab_stage.py"), "--which", "ring"], 900),
     ("kernel_gate",
      [PY, os.path.join(REPO, "scripts", "tpu_kernel_gate.py")], 1200),
+    # paged decode: Mosaic kernel vs dense gather across kv_limit buckets
+    # plus the chunked-prefill stall A/B (parity-gated; timings recorded)
+    ("paged_decode",
+     [PY, os.path.join(REPO, "scripts", "paged_decode_bench.py")], 900),
     ("churn_1b",
      [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
       "--stage", "churn", "--model", "llama3.2-1b"], 900),

@@ -1,0 +1,269 @@
+"""Paged decode-attention bench: gather vs Pallas kernel, one BENCH JSON line.
+
+Two measurements for the gather-free paged decode path (docs/serving.md):
+
+1. **Decode-step latency** across ``--kv-limits`` buckets: the same tiny
+   decode step (batch ``--batch``, one token per lane) run with
+   ``use_paged_kernel`` off (dense block-table gather then attention) and
+   on (``kernels/paged_attention_pallas`` reads the pool in place).  On a
+   real chip the kernel column is the Mosaic kernel; on CPU it runs in
+   interpret mode, so the timing columns are only meaningful on TPU — the
+   *parity* gate (greedy argmax identical per bucket) holds everywhere.
+
+2. **Decode-stall A/B** for chunked prefill: short prompts decode while a
+   long prompt is admitted, once with ``prefill_chunk_tokens`` unset (the
+   whole suffix prefills in one program call, stalling that step) and once
+   chunked.  The record carries the max/mean per-step wall time of both
+   runs plus the chunk count; the gate is greedy-output parity between the
+   two runs (timing is reported, not gated — CPU jitter would flake).
+
+Gates (record still prints on failure, like kv_block_bench.py):
+
+- per-``kv_limit`` greedy argmax parity, kernel vs gather
+- token-identical greedy outputs, chunked vs unchunked admission
+
+Usage::
+
+    python scripts/paged_decode_bench.py            # kv_limits 64,128,256
+    python scripts/paged_decode_bench.py --smoke    # seconds-scale CPU check
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def build_args(argv=None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale workload (CI); overrides the "
+                    "workload knobs below")
+    ap.add_argument("--kv-limits", default="64,128,256",
+                    help="comma-separated kv_limit buckets for the "
+                    "decode-step timing sweep")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    # stall A/B workload
+    ap.add_argument("--short-prompts", type=int, default=3)
+    ap.add_argument("--short-tokens", type=int, default=12)
+    ap.add_argument("--long-tokens", type=int, default=96)
+    ap.add_argument("--prefill-chunk-tokens", type=int, default=16)
+    ap.add_argument("--max-new-tokens", type=int, default=12)
+    ap.add_argument("--max-seq-len", type=int, default=256)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.kv_limits = "32"
+        args.block_size = 8
+        args.iters = 3
+        args.warmup = 1
+        args.short_tokens = 5
+        args.long_tokens = 30
+        args.prefill_chunk_tokens = 8
+        args.max_new_tokens = 6
+        args.max_seq_len = 64
+    args.kv_limit_list = [int(x) for x in args.kv_limits.split(",") if x]
+    return args
+
+
+def _decode_case(config, params, kv_limit, args):
+    """Time one decode step at ``kv_limit``, gather vs kernel; check parity."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
+
+    b, bs = args.batch, args.block_size
+    nblk = -(-kv_limit // bs)
+    num_blocks = b * nblk + 1  # +1 for the NULL block at slot 0
+    rng = np.random.default_rng(args.seed)
+
+    tables = np.zeros((b, nblk), np.int32)
+    ids = iter(range(1, num_blocks))
+    for i in range(b):
+        for j in range(nblk):
+            tables[i, j] = next(ids)
+    tables = jnp.asarray(tables)
+    positions = jnp.full((b,), kv_limit - 1, jnp.int32)
+    hist = jnp.asarray(
+        rng.integers(0, config.vocab_size, (b, kv_limit - 1)), jnp.int32
+    )
+    toks = jnp.asarray(rng.integers(0, config.vocab_size, (b, 1)), jnp.int32)
+
+    out = {}
+    for flag in (False, True):
+        cfg = dataclasses.replace(config, use_paged_kernel=flag)
+        model = LlamaDecode(cfg)
+        cache = model.init_paged_cache(num_blocks, bs)
+        # fill the first kv_limit-1 rows via the gather path (identical
+        # cache contents for both flags), then time the single-token step
+        base = LlamaDecode(config)
+        _, cache = base.forward(
+            params, cache, hist, jnp.zeros((b,), jnp.int32), None,
+            block_tables=tables, kv_limit=kv_limit,
+        )
+
+        def step(params, cache, toks, positions, tables, model=model):
+            logits, _ = model.forward(
+                params, cache, toks, positions, None,
+                block_tables=tables, kv_limit=kv_limit,
+            )
+            return logits
+
+        step = jax.jit(step)
+        for _ in range(args.warmup):
+            logits = step(params, cache, toks, positions, tables)
+        logits.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            logits = step(params, cache, toks, positions, tables)
+        logits.block_until_ready()
+        dt = (time.perf_counter() - t0) / args.iters
+        out[flag] = {
+            "ms": dt * 1e3,
+            "argmax": np.asarray(jnp.argmax(logits[:, -1], axis=-1)),
+            "logits": np.asarray(logits, np.float32),
+        }
+
+    parity = bool((out[True]["argmax"] == out[False]["argmax"]).all())
+    max_err = float(np.abs(out[True]["logits"] - out[False]["logits"]).max())
+    return {
+        "kv_limit": kv_limit,
+        "gather_ms": round(out[False]["ms"], 3),
+        "kernel_ms": round(out[True]["ms"], 3),
+        "argmax_parity": parity,
+        "max_abs_logit_err": round(max_err, 6),
+    }
+
+
+def _stall_ab(config, params, args):
+    """Per-step wall time around a long-prompt admission, chunked vs not."""
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    shorts = [
+        rng.integers(0, config.vocab_size, size=(args.short_tokens,)).tolist()
+        for _ in range(args.short_prompts)
+    ]
+    long_prompt = rng.integers(
+        0, config.vocab_size, size=(args.long_tokens,)
+    ).tolist()
+    gen = GenerationConfig(max_new_tokens=args.max_new_tokens)
+    buckets = [8, 16, 32, 64, 128]
+    buckets = [x for x in buckets if x <= args.max_seq_len]
+    num_blocks = 4 * (args.max_seq_len // args.block_size)
+
+    def run(chunk):
+        eng = InferenceEngine(
+            config, params,
+            max_batch=args.max_batch, max_seq_len=args.max_seq_len,
+            buckets=buckets,
+        )
+        paged = PagedServingEngine(
+            eng, gen,
+            PagedConfig(
+                block_size=args.block_size, num_blocks=num_blocks,
+                prefill_chunk_tokens=chunk,
+            ),
+        )
+        for p in shorts:
+            paged.submit(p)
+        # one step so the shorts are decoding before the long prompt lands
+        step_s = []
+        t0 = time.perf_counter()
+        alive = paged.step()
+        step_s.append(time.perf_counter() - t0)
+        paged.submit(long_prompt)
+        while alive:
+            t0 = time.perf_counter()
+            alive = paged.step()
+            step_s.append(time.perf_counter() - t0)
+        # alive is False, so this returns the finished map without stepping
+        return paged.run_to_completion(), step_s, paged.metrics
+
+    out_plain, steps_plain, _ = run(None)
+    out_chunk, steps_chunk, m_chunk = run(args.prefill_chunk_tokens)
+    return {
+        "stall_unchunked_max_step_ms": round(max(steps_plain) * 1e3, 3),
+        "stall_unchunked_mean_step_ms": round(
+            sum(steps_plain) / len(steps_plain) * 1e3, 3),
+        "stall_chunked_max_step_ms": round(max(steps_chunk) * 1e3, 3),
+        "stall_chunked_mean_step_ms": round(
+            sum(steps_chunk) / len(steps_chunk) * 1e3, 3),
+        "prefill_chunks": m_chunk.prefill_chunks,
+        "chunked_parity": out_plain == out_chunk,
+    }
+
+
+def run_bench(args: argparse.Namespace) -> dict:
+    import jax
+
+    from neuronx_distributed_llama3_2_tpu.models import resolve_model
+
+    entry = resolve_model(args.model)
+    config = dataclasses.replace(entry["config"], max_seq_len=args.max_seq_len)
+    params = entry["model_cls"](config).init(jax.random.key(args.seed))
+
+    cases = [
+        _decode_case(config, params, limit, args)
+        for limit in args.kv_limit_list
+    ]
+    stall = _stall_ab(config, params, args)
+
+    record = {
+        "bench": "paged_decode",
+        "model": args.model,
+        "chip": str(jax.devices()[0]),
+        "smoke": bool(args.smoke),
+        "batch": args.batch,
+        "block_size": args.block_size,
+        "iters": args.iters,
+        "decode_cases": cases,
+        **stall,
+    }
+    failures = []
+    for c in cases:
+        if not c["argmax_parity"]:
+            failures.append(
+                f"kernel/gather greedy argmax diverges at kv_limit={c['kv_limit']}"
+            )
+    if not stall["chunked_parity"]:
+        failures.append("chunked-prefill outputs diverge from unchunked")
+    if failures:
+        record["gate_failure"] = "; ".join(failures)
+    return record
+
+
+def main() -> None:
+    args = build_args()
+    record = run_bench(args)
+    # the record prints even when a gate fails: a regression must still
+    # yield the measured numbers, not just an exception tail
+    print(json.dumps(record), flush=True)
+    if record.get("gate_failure"):
+        raise SystemExit(record["gate_failure"])
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,63 @@ def _case(name, b, s, n, nkv, d, causal, segments, seed, block_q, block_kv):
     return ok
 
 
+def _paged_case(name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed):
+    """Paged flash-decode kernel vs the dense block-table gather reference.
+
+    Forward-only (the decode kernel has no backward; serving never
+    differentiates through it). bf16 pool + queries, like serving decode.
+    """
+    from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (
+        paged_flash_decode,
+    )
+
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = (jax.random.normal(ks[0], (b, n, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    kp = (jax.random.normal(ks[1], (nb, bs, nkv, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    vp = (jax.random.normal(ks[2], (nb, bs, nkv, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    rng = np.random.default_rng(seed)
+    nblk = -(-kv_limit // bs)
+    perm = rng.permutation(np.arange(1, nb))
+    tables = np.zeros((b, w), np.int32)
+    for i in range(b):
+        tables[i, :nblk] = perm[i * nblk:(i + 1) * nblk]
+    tables = jnp.asarray(tables)
+    positions = jnp.asarray(
+        rng.integers(0, kv_limit, size=(b,)), jnp.int32
+    ).at[0].set(kv_limit - 1)
+
+    def ref(q, kp, vp):
+        # dense gather: exactly what the kernel replaces
+        g = n // nkv
+        jlog = jnp.arange(kv_limit)
+        phys = tables[:, jlog // bs] * bs + (jlog % bs)
+        kf = kp.reshape(nb * bs, nkv, d)[phys]          # (b, L, nkv, d)
+        vf = vp.reshape(nb * bs, nkv, d)[phys]
+        qg = q.reshape(b, nkv, g, d).astype(jnp.float32)
+        logits = jnp.einsum("bhgd,blhd->bhgl", qg, kf.astype(jnp.float32))
+        logits = logits / jnp.sqrt(jnp.float32(d))
+        mask = (jlog[None, :] <= positions[:, None])[:, None, None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bhgl,blhd->bhgd", p, vf.astype(jnp.float32))
+        return o.reshape(b, n, d)
+
+    o_k = jax.jit(
+        lambda q, kp, vp: paged_flash_decode(
+            q, kp, vp, tables, positions,
+            kv_limit=kv_limit, num_splits=num_splits,
+        )
+    )(q, kp, vp)
+    o_r = jax.jit(ref)(q, kp, vp)
+    o_k = np.asarray(o_k, np.float32)
+    o_r = np.asarray(o_r, np.float32)
+    denom = max(float(np.abs(o_r).max()), 1e-9)
+    rel = float(np.abs(o_k - o_r).max()) / denom
+    ok = rel < 3e-2  # bf16 inputs; fp32 softmax inside both
+    print(f"[{'ok' if ok else 'FAIL'}] {name}: rel_fwd={rel:.2e}")
+    return ok
+
+
 def main() -> int:
     if jax.default_backend() == "cpu":
         print("tpu_kernel_gate: no TPU backend available (CPU only) — skipping")
@@ -89,6 +146,14 @@ def main() -> int:
     ok = True
     for c in cases:
         ok &= _case(*c)
+    #          name            b  n  nkv d   nb  bs  w  L    splits seed
+    paged_cases = [
+        ("paged-gqa",          4, 8, 2, 64, 33, 16, 8, 128, 4, 10),
+        ("paged-mha",          2, 4, 4, 64, 17, 16, 4, 64,  2, 11),
+        ("paged-ragged-limit", 3, 8, 2, 64, 33, 16, 8, 100, 4, 12),
+    ]
+    for c in paged_cases:
+        ok &= _paged_case(*c)
     print("tpu_kernel_gate:", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 

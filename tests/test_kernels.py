@@ -138,3 +138,111 @@ def test_chunked_ce_matches_full():
     assert abs(float(ref_l) - float(l2)) < 1e-5
     for a, b in zip(jax.tree.leaves(ref_g), jax.tree.leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# paged flash-decode kernel (gather-free block-table attention)
+# ---------------------------------------------------------------------------
+
+from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (  # noqa: E402
+    paged_flash_decode,
+)
+
+
+def _paged_decode_ref(q, kp, vp, tables, positions, kv_limit):
+    """Dense-gather reference: materialize the K/V rows through the table
+    (exactly what the kernel must avoid), grouped GQA masked softmax."""
+    nb, bs, nkv, d = kp.shape
+    jlog = jnp.arange(kv_limit)
+    phys = tables[:, jlog // bs] * bs + (jlog % bs)[None, :]
+    k_all = kp.reshape(nb * bs, nkv, d)[phys]  # (b, limit, NKV, D)
+    v_all = vp.reshape(nb * bs, nkv, d)[phys]
+    g = q.shape[1] // nkv
+    qg = q.reshape(q.shape[0], nkv, g, d)
+    sc = jnp.einsum("bskd,bkgd->bkgs", k_all, qg) * (d ** -0.5)
+    mask = (
+        jnp.arange(kv_limit)[None, None, None, :]
+        <= positions[:, None, None, None]
+    )
+    sc = jnp.where(mask, sc, -jnp.inf)
+    p = jax.nn.softmax(sc, axis=-1)
+    return jnp.einsum("bkgs,bskd->bkgd", p, v_all).reshape(q.shape)
+
+
+def _paged_pool(b, n, nkv, d, nb, bs, w, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, n, d)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((nb, bs, nkv, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((nb, bs, nkv, d)), jnp.float32)
+    # shuffled non-null pool blocks per lane: exercises real indirection
+    tables = jnp.asarray(
+        np.stack([rng.permutation(np.arange(1, nb))[:w] for _ in range(b)]),
+        jnp.int32,
+    )
+    return q, kp, vp, tables
+
+
+@pytest.mark.parametrize("num_splits", [1, 2, 4])
+def test_paged_decode_matches_gather_reference(num_splits):
+    """Flash-decoding split-K over block tables == dense-gather softmax, for
+    any split count (the LSE combine must be exact)."""
+    b, n, nkv, d, nb, bs, w = 3, 4, 2, 8, 16, 8, 8
+    kv_limit = 64
+    q, kp, vp, tables = _paged_pool(b, n, nkv, d, nb, bs, w)
+    # positions hitting: block start, mid-block (partial last block), last row
+    positions = jnp.asarray([0, 17, 63], jnp.int32)
+    ref = _paged_decode_ref(q, kp, vp, tables, positions, kv_limit)
+    out = paged_flash_decode(
+        q, kp, vp, tables, positions, kv_limit=kv_limit, num_splits=num_splits
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_gqa_groups_and_kv_limit():
+    """GQA grouping (G=4) and a kv_limit that is not a multiple of the
+    split count: padding blocks past nblk must contribute nothing."""
+    b, n, nkv, d, nb, bs, w = 2, 8, 2, 8, 24, 8, 12
+    kv_limit = 40  # 5 blocks, split 4 ways -> 2 blocks/split, 3 padded
+    q, kp, vp, tables = _paged_pool(b, n, nkv, d, nb, bs, w, seed=7)
+    positions = jnp.asarray([39, 8], jnp.int32)
+    ref = _paged_decode_ref(q, kp, vp, tables, positions, kv_limit)
+    out = paged_flash_decode(
+        q, kp, vp, tables, positions, kv_limit=kv_limit, num_splits=4
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_masks_garbage_blocks():
+    """Rows past a lane's position are masked whatever the table points at:
+    aliasing every later entry to a garbage-filled block must not change the
+    output (the null-block invariant the serving engine relies on)."""
+    b, n, nkv, d, nb, bs, w = 2, 4, 2, 8, 16, 8, 8
+    kv_limit = 64
+    q, kp, vp, tables = _paged_pool(b, n, nkv, d, nb, bs, w, seed=3)
+    positions = jnp.asarray([11, 20], jnp.int32)
+    out = paged_flash_decode(q, kp, vp, tables, positions, kv_limit=kv_limit)
+    # frontier block index per lane is 1 and 2; alias everything after it
+    aliased = np.asarray(tables).copy()
+    aliased[0, 2:] = 15
+    aliased[1, 3:] = 15
+    out2 = paged_flash_decode(
+        q, kp, vp, jnp.asarray(aliased), positions, kv_limit=kv_limit
+    )
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out), atol=1e-6)
+
+
+def test_paged_decode_bf16_pool_fp32_query():
+    """cache_dtype=bf16 pool under an fp32 query: the kernel casts K/V to
+    the query dtype in-register, like the gather path's .astype."""
+    b, n, nkv, d, nb, bs, w = 2, 4, 2, 8, 16, 8, 8
+    q, kp, vp, tables = _paged_pool(b, n, nkv, d, nb, bs, w, seed=5)
+    positions = jnp.asarray([30, 61], jnp.int32)
+    ref = _paged_decode_ref(
+        q, kp.astype(jnp.bfloat16).astype(jnp.float32),
+        vp.astype(jnp.bfloat16).astype(jnp.float32), tables, positions, 64,
+    )
+    out = paged_flash_decode(
+        q, kp.astype(jnp.bfloat16), vp.astype(jnp.bfloat16), tables,
+        positions, kv_limit=64,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -222,3 +222,211 @@ def test_metrics_snapshot_shape(params):
         "free_blocks", "prefix_hit_rate", "radix_nodes",
     ):
         assert key in snap
+
+
+# ---------------------------------------------------------------------------
+# gather-free decode kernel (use_paged_kernel) + chunked prefill
+# ---------------------------------------------------------------------------
+
+TINY_KERNEL = dataclasses.replace(TINY, use_paged_kernel=True)
+
+
+def _kernel_engine(params, max_batch=4, max_seq_len=64, buckets=(8, 16, 32)):
+    return InferenceEngine(
+        TINY_KERNEL, params,
+        max_batch=max_batch, max_seq_len=max_seq_len, buckets=list(buckets),
+    )
+
+
+def test_paged_kernel_engine_matches_dense(params):
+    """Acceptance: with use_paged_kernel, run_to_completion greedy outputs
+    are token-identical to the dense engine on the mixed-length fixture."""
+    gen = GenerationConfig(max_new_tokens=8)
+    prompts = _prompts(np.random.default_rng(3), (5, 12, 20, 9, 17, 3))
+    paged = PagedServingEngine(
+        _kernel_engine(params), gen, PagedConfig(block_size=8, num_blocks=64)
+    )
+    for p in prompts:
+        paged.submit(p)
+    assert paged.run_to_completion() == _dense_outputs(params, prompts, gen)
+
+
+def test_paged_kernel_cow_partial_prefix_matches_dense(params):
+    """Kernel path over a partially-shared prefix: the second prompt
+    diverges mid-block, so its table carries a COW copy — outputs must
+    still match dense exactly."""
+    gen = GenerationConfig(max_new_tokens=4)
+    rng = np.random.default_rng(11)
+    base = rng.integers(0, TINY.vocab_size, size=(27,)).tolist()
+    p1 = base + [1]
+    p2 = base + [2, 3]  # diverges at token 27, mid-block for block_size=8
+    paged = PagedServingEngine(
+        _kernel_engine(params), gen, PagedConfig(block_size=8, num_blocks=64)
+    )
+    paged.submit(p1)
+    out1 = paged.run_to_completion()
+    paged.submit(p2)
+    out2 = paged.run_to_completion()
+    assert paged.allocator.cow_copies >= 1
+    assert paged.request_info(1)["cached_tokens"] == 27
+    dense = _dense_outputs(params, [p1, p2], gen)
+    assert {0: out1[0], 1: out2[1]} == dense
+
+
+def test_paged_kernel_chunked_prefill_matches_dense(params):
+    """Kernel + chunked prefill together (the full tentpole config)."""
+    gen = GenerationConfig(max_new_tokens=8)
+    prompts = _prompts(np.random.default_rng(3), (5, 30, 20, 9, 26, 3))
+    paged = PagedServingEngine(
+        _kernel_engine(params), gen,
+        PagedConfig(block_size=8, num_blocks=64, prefill_chunk_tokens=8),
+    )
+    for p in prompts:
+        paged.submit(p)
+    out = paged.run_to_completion()
+    assert out == _dense_outputs(params, prompts, gen)
+    assert paged.metrics.prefill_chunks > 0
+
+
+def test_paged_kernel_decode_never_materializes_gather(params):
+    """Acceptance: the decode jaxpr must not contain a (b, kv_limit, NKV, D)
+    gathered K/V array anywhere (including nested scan/jit sub-jaxprs) when
+    the kernel is on — and must contain it when it is off (sanity check
+    that the assertion actually detects the gather)."""
+    import jax.numpy as jnp
+
+    from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
+
+    b, kv_limit, nb, bs, w = 4, 32, 16, 8, 8
+
+    def all_shapes(jaxpr, acc):
+        for eqn in jaxpr.eqns:
+            for v in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(v, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    acc.add(tuple(aval.shape))
+            for p in eqn.params.values():
+                for x in (p if isinstance(p, (list, tuple)) else [p]):
+                    if hasattr(x, "jaxpr"):       # ClosedJaxpr
+                        all_shapes(x.jaxpr, acc)
+                    elif hasattr(x, "eqns"):      # raw Jaxpr
+                        all_shapes(x, acc)
+        return acc
+
+    forbidden = (b, kv_limit, TINY.num_kv_heads, TINY.head_dim)
+    for flag, expect_gather in ((False, True), (True, False)):
+        cfg = dataclasses.replace(TINY, use_paged_kernel=flag)
+        model = LlamaDecode(cfg)
+        cache = model.init_paged_cache(nb, bs)
+        closed = jax.make_jaxpr(
+            lambda p, c, t, ps, tb: model.forward(  # noqa: B023
+                p, c, t, ps, None, block_tables=tb, kv_limit=kv_limit
+            )
+        )(
+            params, cache, jnp.zeros((b, 1), jnp.int32),
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b, w), jnp.int32),
+        )
+        shapes = all_shapes(closed.jaxpr, set())
+        assert (forbidden in shapes) is expect_gather, (
+            f"use_paged_kernel={flag}: gather aval {forbidden} "
+            f"{'missing' if expect_gather else 'present'} in decode jaxpr"
+        )
+
+
+def test_chunked_prefill_interleaves_decode(params):
+    """Acceptance: with prefill_chunk_tokens set, a long-prompt admission
+    interleaves — the already-active lane gains a decode token on the same
+    steps that advance the new request's prefill chunks."""
+    gen = GenerationConfig(max_new_tokens=16)
+    rng = np.random.default_rng(9)
+    pa = rng.integers(0, TINY.vocab_size, size=(4,)).tolist()
+    pb = rng.integers(0, TINY.vocab_size, size=(32,)).tolist()
+    paged = PagedServingEngine(
+        _engine(params), gen,
+        PagedConfig(block_size=8, num_blocks=64, prefill_chunk_tokens=8),
+    )
+    ra = paged.submit(pa)
+    paged.step()  # A admitted (short prompt: unchunked) and decoding
+    rb = paged.submit(pb)
+    trace = []  # (A generated, B prefill progress, B still prefilling)
+    for _ in range(4):
+        paged.step()
+        a, b = paged._requests[ra], paged._requests[rb]
+        trace.append((len(a.out), b.prefill_pos, b.prefilling))
+    # B took all 4 steps of chunked prefill (32 tokens / 8 per chunk) ...
+    assert [t[1] for t in trace] == [8, 16, 24, 32]
+    assert [t[2] for t in trace] == [True, True, True, False]
+    # ... and A decoded one token on every one of those steps
+    assert [t[0] for t in trace] == [3, 4, 5, 6]
+    assert paged.metrics.prefill_chunks == 4
+    assert paged.request_info(rb)["prefilling"] is False
+    out = paged.run_to_completion()
+    assert out == _dense_outputs(params, [pa, pb], gen)
+
+
+def test_preempt_resume_mid_chunked_prefill(params):
+    """An older lane's decode growth exhausts the pool while a younger
+    request is mid-chunked-prefill: the victim is caught prefilling, is
+    requeued, re-admits, and the final outputs still match dense."""
+    gen = GenerationConfig(max_new_tokens=8)
+    rng = np.random.default_rng(21)
+    pa = rng.integers(0, TINY.vocab_size, size=(8,)).tolist()
+    pb = rng.integers(0, TINY.vocab_size, size=(30,)).tolist()
+    paged = PagedServingEngine(
+        _engine(params), gen,
+        PagedConfig(
+            block_size=4, num_blocks=12, decode_reserve_blocks=1,
+            prefill_chunk_tokens=4,
+        ),
+    )
+    preempted = []  # (rid, was_prefilling) at preemption time
+    orig = paged._preempt
+
+    def spy(req):
+        preempted.append((req.rid, req.prefilling))
+        orig(req)
+
+    paged._preempt = spy
+    ra = paged.submit(pa)
+    rb = paged.submit(pb)
+    out = paged.run_to_completion()
+    assert (rb, True) in preempted, preempted
+    assert paged.request_info(rb)["preemptions"] >= 1
+    assert out == _dense_outputs(params, [pa, pb], gen)
+    assert paged.allocator.active_blocks == 0
+    del ra
+
+
+def test_request_info_map_covers_all_lifecycle_states(params):
+    gen = GenerationConfig(max_new_tokens=4)
+    paged = PagedServingEngine(
+        _engine(params, max_batch=1), gen,
+        PagedConfig(block_size=8, num_blocks=64),
+    )
+    r0 = paged.submit(_prompts(np.random.default_rng(0), (10,))[0])
+    r1 = paged.submit(_prompts(np.random.default_rng(1), (10,))[0])
+    paged.step()  # r0 active (sole lane), r1 still queued
+    assert paged.request_info(r0)["generated_tokens"] >= 1
+    assert paged.request_info(r1)["generated_tokens"] == 0
+    paged.run_to_completion()
+    assert paged.request_info(r0)["done"] is True
+    assert paged.request_info(r1)["done"] is True
+    with pytest.raises(KeyError, match="unknown request id"):
+        paged.request_info(99)
+
+
+def test_admit_blocked_counter(params):
+    """Admission deferrals on the block budget are counted (and flow into
+    the metrics log line via snapshot())."""
+    gen = GenerationConfig(max_new_tokens=8)
+    prompts = _prompts(np.random.default_rng(5), (12, 12, 12, 12))
+    paged = PagedServingEngine(
+        _engine(params), gen,
+        PagedConfig(block_size=8, num_blocks=10, decode_reserve_blocks=1),
+    )
+    for p in prompts:
+        paged.submit(p)
+    paged.run_to_completion()
+    assert paged.metrics.admit_blocked > 0
+    snap = paged.metrics.snapshot(paged.allocator, paged.index)
+    assert "admit_blocked" in snap and "prefill_chunks" in snap
