@@ -49,6 +49,16 @@ from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
 logger = get_logger()
 
 
+def read_host_tokens(tokens: jax.Array) -> np.ndarray:
+    """THE host-readback choke point for every serving/generate loop: one
+    conversion (``np.asarray`` on a jax Array transfers and converts in a
+    single step — no ``device_get`` + ``asarray`` double hop), one place to
+    instrument. The paged engine's ``_read_tokens`` wraps this with
+    device-wait timing; anything else that needs sampled tokens on the host
+    goes through here so a future loop change has a single seam."""
+    return np.asarray(tokens)
+
+
 def default_buckets(max_seq_len: int, min_bucket: int = 128) -> List[int]:
     """Powers-of-2 bucket ladder up to max_seq_len (reference
     autobucketing.py:6 generate_buckets)."""
@@ -438,7 +448,7 @@ class InferenceEngine:
             jnp.asarray(slots, dtype=jnp.int32),
             key,
         )
-        return np.asarray(jax.device_get(tokens))
+        return read_host_tokens(tokens)
 
     # -- generate ---------------------------------------------------------
 
@@ -533,7 +543,7 @@ class InferenceEngine:
                 toks_block, tokens, key, self.cache = decode_multi(
                     self.params, self.cache, tokens, positions, slots, key
                 )
-                block_host = np.asarray(jax.device_get(toks_block))  # (steps, b)
+                block_host = read_host_tokens(toks_block)  # (steps, b)
                 dt = time.perf_counter() - t0
                 for _ in range(steps):
                     bench.per_token.record(dt / steps)
@@ -546,7 +556,7 @@ class InferenceEngine:
                     tokens, _, self.cache = decode(
                         self.params, self.cache, tokens, positions, slots, kd
                     )
-                    tokens_host = np.asarray(jax.device_get(tokens))
+                    tokens_host = read_host_tokens(tokens)
                 block_host = tokens_host[None, :]
                 positions = positions + 1
                 emitted = 1
@@ -713,7 +723,7 @@ class ContinuousBatchingEngine:
             jnp.arange(b, dtype=jnp.int32),
             k,
         )
-        toks = np.asarray(jax.device_get(toks))
+        toks = read_host_tokens(toks)
         for slot, req in list(self._active.items()):
             req.out.append(int(toks[slot]))
             req.position += 1

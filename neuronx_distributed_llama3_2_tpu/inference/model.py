@@ -428,6 +428,40 @@ class LlamaDecode:
                 )
         return att, kc, vc
 
+    def decode_step(
+        self,
+        params: Params,
+        cache: PagedKVCache,
+        tokens: jax.Array,       # (b,) int32 — last sampled token per lane
+        positions: jax.Array,    # (b,) int32 — write row per lane
+        block_tables: jax.Array,  # (b, W) int32
+        *,
+        kv_limit: Optional[int] = None,
+        pos_cap: Optional[int] = None,
+    ) -> Tuple[jax.Array, jax.Array, PagedKVCache]:
+        """One resident-state decode step: T=1 paged forward plus the
+        on-device state advance. Returns ``(logits (b, V), new_positions,
+        cache)`` where ``new_positions = positions + 1`` — the sampled token
+        and incremented position ARE the next step's inputs, so a serving
+        loop can dispatch step N+1 without any host round trip (the
+        double-buffered async loop in ``serving/engine.py``).
+
+        ``pos_cap`` clamps the advanced positions (static). Idle lanes in a
+        resident batch keep stepping with all-null tables — their writes
+        land in the null block and their outputs are discarded — so without
+        a cap a long-idle lane's position would eventually walk past the
+        rope table. The cap only ever binds on such garbage lanes: real
+        lanes finish at ``max_seq_len - 1``, below any sane cap.
+        """
+        logits, cache = self.forward(
+            params, cache, tokens[:, None], positions, None,
+            block_tables=block_tables, kv_limit=kv_limit,
+        )
+        new_positions = positions + 1
+        if pos_cap is not None:
+            new_positions = jnp.minimum(new_positions, pos_cap)
+        return logits[:, 0, :], new_positions, cache
+
     def _paged_kernel_eligible(self, t: int, tree) -> bool:
         """Gate for the Pallas paged-decode kernel: the ``use_paged_kernel``
         config opt-in, T == 1 token-gen only (suffix prefill and tree

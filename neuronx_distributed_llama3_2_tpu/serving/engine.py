@@ -36,6 +36,7 @@ than the dense engine, so sampled streams are valid, not bit-matching.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -46,6 +47,7 @@ from neuronx_distributed_llama3_2_tpu.inference.engine import (
     GenerationConfig,
     InferenceEngine,
     pick_bucket,
+    read_host_tokens,
 )
 from neuronx_distributed_llama3_2_tpu.inference.sampling import (
     SamplingConfig,
@@ -88,6 +90,13 @@ class PagedConfig:
     # a long prompt no longer stalls every decode stream for its whole
     # prefill. None/0 = off (whole-suffix prefill at admission, as before).
     prefill_chunk_tokens: Optional[int] = None
+    # async double-buffered decode (docs/serving.md "Async step pipeline"):
+    # when no scheduler event is pending, dispatch step N+1 from the
+    # device-resident state before reading step N's tokens back, so host
+    # scheduling overlaps device compute. Token-identical to the sync loop
+    # for greedy sampling; EOS/max-len detection lags one step and the
+    # extra "lame-duck" token is discarded.
+    async_loop: bool = False
 
 
 @dataclasses.dataclass
@@ -107,6 +116,10 @@ class _PagedRequest:
     prefilling: bool = False
     prefill_pos: int = 0
     prefill_target: int = 0
+    # chunked prefill: the (1, W) device block table shared by every chunk
+    # of this admission (the table is fixed for the whole chunk walk, so it
+    # uploads once, not once per chunk); dropped on install/preempt/finish
+    table_dev: Any = None
 
 
 class PagedServingEngine:
@@ -171,11 +184,37 @@ class PagedServingEngine:
         self._requests: Dict[int, _PagedRequest] = {}
         self._free_lanes = list(range(engine.max_batch))
         self._key = jax.random.key(gen.seed)
+        # host MIRRORS of the decode state — the scheduler reads these for
+        # kv-bucket routing / block accounting; the authoritative copies
+        # live on device (below) and are mutated by tiny jitted update
+        # programs, never re-uploaded wholesale per step
         self._tokens = np.zeros((engine.max_batch,), np.int32)
         self._positions = np.zeros((engine.max_batch,), np.int32)
         self._tables = np.full(
             (engine.max_batch, self.table_width), NULL_BLOCK, np.int32
         )
+        # device-RESIDENT decode state: every decode dispatch (sync or
+        # async) consumes these arrays; the decode program writes its
+        # sampled token and incremented position back into them, so a
+        # steady-state step needs zero host→device transfers
+        self._d_tokens = jnp.asarray(self._tokens)
+        self._d_positions = jnp.asarray(self._positions)
+        self._d_tables = jnp.asarray(self._tables)
+        # advanced positions are clamped here: keeps a long-idle garbage
+        # lane's position inside the rope table (see LlamaDecode.decode_step)
+        self._pos_cap = self.table_width * bs - 1
+        # lanes whose host-mirror state must be pushed to device before the
+        # next dispatch (admitted / finished / preempted / installed lanes),
+        # and single block-table entries appended by decode block growth
+        self._dirty_lanes: set = set()
+        self._table_delta_list: List[tuple] = []  # (lane, col, block_id)
+        # depth-1 lookahead: the dispatched-but-unread decode step
+        # (tokens device array, decode-lane snapshot, dispatch index)
+        self._pending: Optional[tuple] = None
+        self._dispatch_count = 0
+        self._last_readback_lag = 0  # dispatches between dispatch and read
+        self._wait_ms = 0.0          # per-step readback wait scratch
+        self._last_log_step = 0      # dedupe periodic metrics logging
         self._programs: Dict[tuple, Any] = {}
         self._copy_block_fn = jax.jit(
             lambda c, s, d: type(c)(
@@ -241,21 +280,83 @@ class PagedServingEngine:
         return self._programs[key_]
 
     def _decode_program(self, cfg: SamplingConfig, kv_limit: int):
+        """Resident-state decode: one T=1 step over the device-resident
+        (tokens, positions, tables), returning the sampled tokens and the
+        advanced positions so step N+1 can dispatch with NO host input.
+        The cache and positions are donated (overwritten in place); tokens
+        are NOT — the previous step's sampled-token array must stay alive
+        for its (lagging) host readback while already feeding this
+        dispatch."""
         key_ = ("pdecode", cfg, kv_limit)
         if key_ in self._programs:
             return self._programs[key_]
         model, engine = self.model, self.engine
+        pos_cap = self._pos_cap
 
         def fn(params, cache, tokens, positions, tables, key):
             params = engine._live_params(params)
-            logits, cache = model.forward(
-                params, cache, tokens[:, None], positions, None,
-                block_tables=tables, kv_limit=kv_limit,
+            logits, new_positions, cache = model.decode_step(
+                params, cache, tokens, positions, tables,
+                kv_limit=kv_limit, pos_cap=pos_cap,
             )
-            return sample(logits[:, 0, :], key, cfg), cache
+            return sample(logits, key, cfg), new_positions, cache
 
-        self._programs[key_] = jax.jit(fn, donate_argnums=(1,))
+        self._programs[key_] = jax.jit(fn, donate_argnums=(1, 3))
         return self._programs[key_]
+
+    def _lane_set_program(self):
+        """Full-lane resident-state update: scatter one lane's (token,
+        position, table row) into the device arrays — the admission /
+        finish / preemption path. All three residents are donated, so the
+        update is an in-place dynamic-update-slice, not a reallocation.
+        Only legal while no lookahead step is in flight (the donated token
+        buffer could be the pending readback)."""
+        key_ = ("lane_set",)
+        if key_ in self._programs:
+            return self._programs[key_]
+
+        def fn(tokens, positions, tables, lane, tok, pos, trow):
+            return (
+                tokens.at[lane].set(tok),
+                positions.at[lane].set(pos),
+                tables.at[lane].set(trow),
+            )
+
+        self._programs[key_] = jax.jit(fn, donate_argnums=(0, 1, 2))
+        return self._programs[key_]
+
+    def _table_delta_program(self):
+        """Single-entry block-table scatter: decode growth appends one
+        block id per boundary crossing; only ``tables`` is touched (and
+        donated), so this is safe to run while a lookahead step is in
+        flight."""
+        key_ = ("table_delta",)
+        if key_ in self._programs:
+            return self._programs[key_]
+
+        def fn(tables, lane, col, val):
+            return tables.at[lane, col].set(val)
+
+        self._programs[key_] = jax.jit(fn, donate_argnums=(0,))
+        return self._programs[key_]
+
+    # -- host<->device choke points ---------------------------------------
+
+    def _upload(self, x, dtype=jnp.int32):
+        """Every host→device transfer on the serving path funnels through
+        here so the steady-state zero-upload property is countable (and
+        testable)."""
+        self.metrics.h2d_uploads += 1
+        return jnp.asarray(x, dtype)
+
+    def _read_tokens(self, toks) -> np.ndarray:
+        """Every device→host token readback funnels through here: one
+        conversion, with the blocking wait accounted as device time
+        (``ServingMetrics.device_wait_ms``)."""
+        t0 = time.perf_counter()
+        arr = read_host_tokens(toks)
+        self._wait_ms += (time.perf_counter() - t0) * 1e3
+        return arr
 
     def _warmup(self) -> None:
         """Compile the decode program per kv bucket and the no-cache prefill
@@ -269,12 +370,14 @@ class PagedServingEngine:
         if kv_buckets[-1] < eng.max_seq_len:
             kv_buckets.append(eng.max_seq_len)
         key = jax.random.key(0)
-        tables = jnp.asarray(self._tables)
         zeros_b = jnp.zeros((eng.max_batch,), jnp.int32)
         for kv in kv_buckets:
             fn = self._decode_program(self.gen.sampling, kv)
-            _, self.cache = fn(
-                eng.params, self.cache, zeros_b, zeros_b, tables, key
+            # positions are donated per call — hand each warmup its own
+            # throwaway array; the resident state itself is untouched
+            _, _, self.cache = fn(
+                eng.params, self.cache, zeros_b,
+                jnp.zeros((eng.max_batch,), jnp.int32), self._d_tables, key,
             )
         table1 = jnp.full((1, self.table_width), NULL_BLOCK, jnp.int32)
         for bucket in eng.buckets:
@@ -389,6 +492,7 @@ class PagedServingEngine:
                 req.prefill_target = len(seq)
                 self._tokens[lane] = 0
                 self._positions[lane] = 0
+                self._dirty_lanes.add(lane)
                 continue
             suffix = seq[cached:]
             self._key, k = jax.random.split(self._key)
@@ -398,6 +502,7 @@ class PagedServingEngine:
             self._tokens[lane] = first
             self._positions[lane] = req.position
             self._tables[lane, : len(table)] = table
+            self._dirty_lanes.add(lane)
             self.metrics.prefill_tokens += len(suffix)
             if self.paged.enable_prefix_caching:
                 # register the prompt's full blocks immediately so requests
@@ -409,30 +514,37 @@ class PagedServingEngine:
             self._maybe_finish(req)
 
     def _prefill(
-        self, suffix: List[int], cached: int, table: List[int], key
+        self, suffix: List[int], cached: int, table: List[int], key,
+        table_dev=None,
     ) -> int:
+        """Run one (whole or chunk) prefill and read its sampled token back.
+        ``table_dev`` short-circuits the per-call block-table upload —
+        chunked prefill passes the same (1, W) device array for every chunk
+        of an admission instead of re-uploading it each time."""
         eng = self.engine
         bucket = pick_bucket(self._prefill_buckets, max(len(suffix), 1))
         ids = np.zeros((1, bucket), np.int32)
         ids[0, : len(suffix)] = suffix
         length = np.asarray([max(len(suffix), 1)], np.int32)
-        tbl = np.full((1, self.table_width), NULL_BLOCK, np.int32)
-        tbl[0, : len(table)] = table
+        if table_dev is None:
+            tbl = np.full((1, self.table_width), NULL_BLOCK, np.int32)
+            tbl[0, : len(table)] = table
+            table_dev = self._upload(tbl)
         if cached == 0:
             fn = self._prefill_ctx_program(bucket, self.gen.sampling)
             tok, self.cache = fn(
-                eng.params, self.cache, jnp.asarray(ids),
-                jnp.asarray(length), jnp.asarray(tbl), key,
+                eng.params, self.cache, self._upload(ids),
+                self._upload(length), table_dev, key,
             )
         else:
             kv_limit = eng._kv_bucket(min(cached + bucket, eng.max_seq_len))
             fn = self._prefill_suffix_program(bucket, kv_limit, self.gen.sampling)
             tok, self.cache = fn(
-                eng.params, self.cache, jnp.asarray(ids),
-                jnp.asarray([cached], np.int32), jnp.asarray(length),
-                jnp.asarray(tbl), key,
+                eng.params, self.cache, self._upload(ids),
+                self._upload(np.asarray([cached], np.int32)),
+                self._upload(length), table_dev, key,
             )
-        return int(np.asarray(jax.device_get(tok))[0])
+        return int(self._read_tokens(tok)[0])
 
     def _advance_prefills(self) -> None:
         """One fixed-budget chunk per prefilling lane per step (Sarathi-Serve
@@ -453,7 +565,13 @@ class PagedServingEngine:
             piece = seq[start: start + chunk]
             final = start + len(piece) >= req.prefill_target
             self._key, k = jax.random.split(self._key)
-            tok = self._prefill(piece, start, req.table, k)
+            if req.table_dev is None:
+                # one upload for the whole chunk walk: the admission
+                # allocated the full table, so every chunk sees the same row
+                tbl = np.full((1, self.table_width), NULL_BLOCK, np.int32)
+                tbl[0, : len(req.table)] = req.table
+                req.table_dev = self._upload(tbl)
+            tok = self._prefill(piece, start, req.table, k, req.table_dev)
             req.prefill_pos = start + len(piece)
             self.metrics.prefill_tokens += len(piece)
             self.metrics.prefill_chunks += 1
@@ -462,11 +580,13 @@ class PagedServingEngine:
             # final chunk: sample the first token, install the real table
             # into the decode batch, register the prompt for prefix sharing
             req.prefilling = False
+            req.table_dev = None
             req.out.append(tok)
             req.position = req.prefill_target
             self._tokens[lane] = tok
             self._positions[lane] = req.position
             self._tables[lane, : len(req.table)] = req.table
+            self._dirty_lanes.add(lane)
             if self.paged.enable_prefix_caching:
                 n_full = len(seq) // bs
                 if n_full:
@@ -488,11 +608,13 @@ class PagedServingEngine:
         req.prefilling = False
         req.prefill_pos = 0
         req.prefill_target = 0
+        req.table_dev = None
         del self._active[lane]
         self._free_lanes.append(lane)
         self._tables[lane, :] = NULL_BLOCK
         self._tokens[lane] = 0
         self._positions[lane] = 0
+        self._dirty_lanes.add(lane)
         self._queue.insert(0, req)
         req.preemptions += 1
         self.metrics.preemptions += 1
@@ -504,7 +626,10 @@ class PagedServingEngine:
     def _ensure_decode_blocks(self) -> None:
         """Every active lane's next write row must be backed by a real
         block; allocate on block boundaries, preempting the youngest active
-        request when the pool (free + evictable) runs dry."""
+        request when the pool (free + evictable) runs dry. The write row is
+        the *dispatch frontier* (``self._positions`` mirror) — equal to
+        ``req.position`` in the sync loop, one ahead of it while a
+        lookahead step is in flight."""
         bs = self.paged.block_size
         for lane in sorted(self._active, key=lambda l: self._active[l].rid):
             req = self._active.get(lane)
@@ -512,26 +637,54 @@ class PagedServingEngine:
                 continue  # preempted while servicing an older lane
             if req.prefilling:
                 continue  # admission already allocated the whole-prompt table
-            if req.position // bs < len(req.table):
+            if int(self._positions[lane]) // bs < len(req.table):
                 continue
             while True:
                 nb = self.allocator.alloc()
                 if nb is not None:
-                    req.table.append(nb)
-                    self._tables[lane, len(req.table) - 1] = nb
+                    self._append_block(lane, req, nb)
                     break
                 victim = max(self._active.values(), key=lambda r: r.rid)
                 self._preempt(victim)
                 if victim is req:
                     break  # preempted ourselves; nothing left to back
 
-    def _maybe_finish(self, req: _PagedRequest) -> None:
+    def _append_block(self, lane: int, req: _PagedRequest, nb: int) -> None:
+        req.table.append(nb)
+        col = len(req.table) - 1
+        self._tables[lane, col] = nb
+        self._table_delta_list.append((lane, col, nb))
+
+    def _ensure_decode_blocks_async(self) -> bool:
+        """Non-preempting variant for the async dispatch path: back every
+        decode lane's next write row from the pool (eviction of cached LRU
+        blocks is fine — pure host bookkeeping), but if an allocation would
+        require preempting an *active* lane, report False so the step drops
+        to the synchronous loop, which drains the in-flight step first and
+        then preempts with a consistent view."""
+        bs = self.paged.block_size
+        for lane in sorted(self._active, key=lambda l: self._active[l].rid):
+            req = self._active[lane]
+            if req.prefilling:
+                continue
+            if int(self._positions[lane]) // bs < len(req.table):
+                continue
+            nb = self.allocator.alloc()
+            if nb is None:
+                return False  # pool dry: preemption needed → sync fallback
+            self._append_block(lane, req, nb)
+        return True
+
+    def _finish_due(self, req: _PagedRequest) -> bool:
         eos = self.gen.eos_token_id
-        if not (
+        return (
             req.done
-            or (eos is not None and req.out and req.out[-1] == eos)
+            or (eos is not None and bool(req.out) and req.out[-1] == eos)
             or len(req.out) >= self.gen.max_new_tokens
-        ):
+        )
+
+    def _maybe_finish(self, req: _PagedRequest) -> None:
+        if not self._finish_due(req) or req.rid in self._finished:
             return
         req.done = True
         bs = self.paged.block_size
@@ -546,23 +699,150 @@ class PagedServingEngine:
             for b in req.table:
                 self.allocator.release(b)
             req.table = []
+            req.table_dev = None
             del self._active[lane]
             self._free_lanes.append(lane)
             self._tables[lane, :] = NULL_BLOCK
             self._tokens[lane] = 0
             self._positions[lane] = 0
+            self._dirty_lanes.add(lane)
             req.lane = None
         self._finished[req.rid] = req
         self.metrics.finished += 1
 
     # -- serving loop -------------------------------------------------------
 
-    def step(self) -> bool:
-        """Admit waiting requests, push one prefill chunk per prefilling
-        lane, then advance every decode-ready lane one token — so a long
-        prompt's chunks interleave with the existing streams' decode steps.
-        Pool exhaustion preempts-and-requeues instead of raising. Returns
-        False when nothing is left to do."""
+    def _flush_state(self) -> None:
+        """Push queued host-side lane mutations into the device-resident
+        arrays. Single-entry table deltas (block growth) donate only the
+        tables array, so they are safe to issue while a lookahead step is
+        in flight; full-lane syncs donate all three residents and may only
+        run with no step pending (dirty lanes are only ever marked by
+        scheduler events, which drain the pipeline first)."""
+        if self._table_delta_list:
+            fn = self._table_delta_program()
+            for lane, col, val in self._table_delta_list:
+                if lane in self._dirty_lanes:
+                    continue  # full-lane sync below rewrites the whole row
+                self._d_tables = fn(
+                    self._d_tables,
+                    self._upload(lane), self._upload(col), self._upload(val),
+                )
+                self.metrics.table_deltas += 1
+            self._table_delta_list.clear()
+        if self._dirty_lanes:
+            assert self._pending is None, "full-lane sync with step in flight"
+            fn = self._lane_set_program()
+            for lane in sorted(self._dirty_lanes):
+                self._d_tokens, self._d_positions, self._d_tables = fn(
+                    self._d_tokens, self._d_positions, self._d_tables,
+                    self._upload(lane),
+                    self._upload(self._tokens[lane]),
+                    self._upload(self._positions[lane]),
+                    self._upload(self._tables[lane]),
+                )
+                self.metrics.lane_syncs += 1
+            self._dirty_lanes.clear()
+
+    def _read_and_apply(self, pending: tuple) -> None:
+        """Read one dispatched step's sampled tokens and advance request
+        state. If a lane finished, the in-flight lookahead step (if any) is
+        its lame-duck step: drain it too, apply its tokens to the surviving
+        lanes (for them it is an ordinary decode step), discard the finished
+        lanes' post-EOS tokens, and only then release the finished lanes'
+        blocks — device program order guarantees the lame-duck KV writes
+        landed before any later program can touch the recycled blocks."""
+        toks, lanes, idx = pending
+        arr = self._read_tokens(toks)
+        self._last_readback_lag = self._dispatch_count - idx
+        eng = self.engine
+        finishing: List[_PagedRequest] = []
+        for lane in lanes:
+            req = self._active[lane]
+            req.out.append(int(arr[lane]))
+            req.position += 1
+            self._tokens[lane] = arr[lane]
+            if req.position >= eng.max_seq_len - 1:
+                req.done = True
+            if self._finish_due(req):
+                finishing.append(req)
+        if finishing and self._pending is not None:
+            # Lame-duck drain: the lookahead step already ran with the
+            # finished lanes still in the batch.
+            toks2, lanes2, idx2 = self._pending
+            self._pending = None
+            arr2 = self._read_tokens(toks2)
+            self._last_readback_lag = self._dispatch_count - idx2
+            dead = {r.lane for r in finishing}
+            for lane in lanes2:
+                if lane in dead:
+                    self.metrics.lame_duck_tokens += 1
+                    continue  # discard the post-finish token
+                req = self._active[lane]
+                req.out.append(int(arr2[lane]))
+                req.position += 1
+                self._tokens[lane] = arr2[lane]
+                if req.position >= eng.max_seq_len - 1:
+                    req.done = True
+                if self._finish_due(req):
+                    finishing.append(req)
+        for req in finishing:
+            self._maybe_finish(req)
+
+    def _drain_pending(self) -> None:
+        """Retire the in-flight lookahead step (if any) before the
+        scheduler mutates lane state. After this, readback lag is zero and
+        full-lane resident syncs are legal again."""
+        if self._pending is None:
+            return
+        pending, self._pending = self._pending, None
+        self._read_and_apply(pending)
+
+    def _async_eligible(self) -> bool:
+        """Steady state: nothing for the scheduler to do this step except
+        advance decode lanes — no waiting queue, no prefill chunks."""
+        if self._queue or not self._active:
+            return False
+        return not any(r.prefilling for r in self._active.values())
+
+    def _step_async(self) -> bool:
+        """One lookahead decode step: dispatch step N+1 entirely from
+        device-resident state (zero host→device uploads), then read back
+        step N's tokens — which the device finished computing while the
+        host was scheduling — for EOS/max-len detection one step late."""
+        self._flush_state()
+        decode_lanes = [
+            l for l, r in self._active.items() if not r.prefilling
+        ]
+        eng = self.engine
+        kv_limit = eng._kv_bucket(
+            int(max(self._positions[l] for l in decode_lanes)) + 1
+        )
+        fn = self._decode_program(self.gen.sampling, kv_limit)
+        self._key, k = jax.random.split(self._key)
+        toks, self._d_positions, self.cache = fn(
+            eng.params, self.cache,
+            self._d_tokens, self._d_positions, self._d_tables, k,
+        )
+        self._d_tokens = toks
+        self._dispatch_count += 1
+        prev, self._pending = self._pending, (
+            toks, decode_lanes, self._dispatch_count,
+        )
+        for lane in decode_lanes:
+            self._positions[lane] += 1  # mirror the on-device advance
+        self.metrics.decode_steps += 1
+        self.metrics.decode_steps_async += 1
+        if prev is not None:
+            self._read_and_apply(prev)
+        return bool(self._active or self._queue)
+
+    def _step_sync(self) -> bool:
+        """The synchronous loop: admission, chunked-prefill advance, then
+        one decode step dispatched and read back within the same call.
+        Still device-resident — dispatch consumes the resident arrays after
+        flushing queued lane updates, so the only per-step host traffic is
+        the token readback."""
         self._admit()
         self._advance_prefills()
         if not any(not r.prefilling for r in self._active.values()):
@@ -573,33 +853,56 @@ class PagedServingEngine:
         ]
         if not decode_lanes:
             return bool(self._active or self._queue)  # re-admit next step
+        self._flush_state()
         eng = self.engine
         kv_limit = eng._kv_bucket(
             int(max(self._positions[l] for l in decode_lanes)) + 1
         )
         fn = self._decode_program(self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)
-        toks, self.cache = fn(
+        toks, self._d_positions, self.cache = fn(
             eng.params, self.cache,
-            jnp.asarray(self._tokens), jnp.asarray(self._positions),
-            jnp.asarray(self._tables), k,
+            self._d_tokens, self._d_positions, self._d_tables, k,
         )
-        toks = np.asarray(jax.device_get(toks))
+        self._d_tokens = toks
+        self._dispatch_count += 1
+        for lane in decode_lanes:
+            self._positions[lane] += 1
         self.metrics.decode_steps += 1
-        for lane, req in list(self._active.items()):
-            if req.prefilling:
-                continue  # null-table lane: its sampled token is garbage
-            req.out.append(int(toks[lane]))
-            req.position += 1
-            self._tokens[lane] = toks[lane]
-            self._positions[lane] = req.position
-            if req.position >= eng.max_seq_len - 1:
-                req.done = True
-            self._maybe_finish(req)
-        every = self.paged.metrics_log_every
-        if every and self.metrics.decode_steps % every == 0:
-            self.metrics.log(logger, self.allocator, self.index)
+        self._read_and_apply((toks, decode_lanes, self._dispatch_count))
         return bool(self._active or self._queue)
+
+    def _step_inner(self) -> bool:
+        if self.paged.async_loop and self._async_eligible():
+            if self._ensure_decode_blocks_async():
+                return self._step_async()
+            # Pool dry: the scheduler must preempt, which mutates lane
+            # state — drop to the synchronous loop for this step.
+            self.metrics.sync_fallbacks += 1
+        self._drain_pending()
+        return self._step_sync()
+
+    def step(self) -> bool:
+        """Admit waiting requests, push one prefill chunk per prefilling
+        lane, then advance every decode-ready lane one token — so a long
+        prompt's chunks interleave with the existing streams' decode steps.
+        Pool exhaustion preempts-and-requeues instead of raising. With
+        ``PagedConfig.async_loop`` the steady-state decode path runs a
+        depth-1 lookahead pipeline (docs/serving.md "Async step pipeline");
+        note per-request state then trails the device by one step until the
+        pipeline drains. Returns False when nothing is left to do."""
+        t0 = time.perf_counter()
+        self._wait_ms = 0.0
+        alive = self._step_inner()
+        total_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.device_wait_ms += self._wait_ms
+        self.metrics.host_schedule_ms += max(total_ms - self._wait_ms, 0.0)
+        every = self.paged.metrics_log_every
+        steps = self.metrics.decode_steps
+        if every and steps and steps % every == 0 and steps != self._last_log_step:
+            self._last_log_step = steps
+            self.metrics.log(logger, self.allocator, self.index)
+        return alive
 
     def run_to_completion(self) -> Dict[int, List[int]]:
         while self.step():

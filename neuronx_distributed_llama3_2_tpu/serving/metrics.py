@@ -33,6 +33,17 @@ class ServingMetrics:
     prefill_tokens: int = 0   # prompt tokens actually pushed through prefill
     prefill_chunks: int = 0   # chunked-prefill program invocations
     cached_tokens: int = 0    # prompt tokens admitted by prefix reference
+    # -- async double-buffered loop (docs/serving.md "Async step pipeline") --
+    decode_steps_async: int = 0  # of decode_steps, dispatched with lookahead
+    lame_duck_tokens: int = 0    # post-finish lookahead tokens discarded
+    sync_fallbacks: int = 0      # async-eligible steps dropped to sync mode
+    # -- resident decode state (device-side tokens/positions/tables) --
+    lane_syncs: int = 0          # full-lane host→device resident-state pushes
+    table_deltas: int = 0        # single-entry block-table scatter updates
+    h2d_uploads: int = 0         # host→device array uploads on the serving path
+    # -- step-phase timing (monotonic clock around dispatch/readback) --
+    host_schedule_ms: float = 0.0  # cumulative step time minus device waits
+    device_wait_ms: float = 0.0    # cumulative blocking token-readback time
 
     def prefix_skip_fraction(self) -> float:
         """Fraction of admitted prompt tokens that skipped prefill."""
@@ -46,6 +57,11 @@ class ServingMetrics:
     ) -> dict:
         rec = dataclasses.asdict(self)
         rec["prefix_skip_fraction"] = round(self.prefix_skip_fraction(), 4)
+        rec["host_schedule_ms"] = round(self.host_schedule_ms, 3)
+        rec["device_wait_ms"] = round(self.device_wait_ms, 3)
+        steps = max(self.decode_steps, 1)
+        rec["host_schedule_ms_per_step"] = round(self.host_schedule_ms / steps, 4)
+        rec["device_wait_ms_per_step"] = round(self.device_wait_ms / steps, 4)
         if allocator is not None:
             rec.update(allocator.stats())
         if index is not None:

@@ -76,10 +76,11 @@ STAGES = [
      [PY, os.path.join(REPO, "scripts", "ab_stage.py"), "--which", "ring"], 900),
     ("kernel_gate",
      [PY, os.path.join(REPO, "scripts", "tpu_kernel_gate.py")], 1200),
-    # paged decode: Mosaic kernel vs dense gather across kv_limit buckets
-    # plus the chunked-prefill stall A/B (parity-gated; timings recorded)
+    # paged decode: Mosaic kernel vs dense gather across kv_limit buckets,
+    # the chunked-prefill stall A/B, and the sync-vs-async serving-loop
+    # steps/sec A/B (all parity-gated; timings recorded)
     ("paged_decode",
-     [PY, os.path.join(REPO, "scripts", "paged_decode_bench.py")], 900),
+     [PY, os.path.join(REPO, "scripts", "paged_decode_bench.py")], 1200),
     ("churn_1b",
      [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
       "--stage", "churn", "--model", "llama3.2-1b"], 900),

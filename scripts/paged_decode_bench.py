@@ -1,6 +1,6 @@
 """Paged decode-attention bench: gather vs Pallas kernel, one BENCH JSON line.
 
-Two measurements for the gather-free paged decode path (docs/serving.md):
+Three measurements for the gather-free paged decode path (docs/serving.md):
 
 1. **Decode-step latency** across ``--kv-limits`` buckets: the same tiny
    decode step (batch ``--batch``, one token per lane) run with
@@ -17,10 +17,18 @@ Two measurements for the gather-free paged decode path (docs/serving.md):
    runs plus the chunk count; the gate is greedy-output parity between the
    two runs (timing is reported, not gated — CPU jitter would flake).
 
+3. **Serving-loop A/B** for the async double-buffered step pipeline: the
+   same mixed workload run to completion with ``PagedConfig.async_loop``
+   off and on, reporting steps/sec for both plus the host-schedule vs
+   device-wait per-step split from ``ServingMetrics``.  The gate is
+   greedy-output parity; the speedup column is meaningful only on a real
+   chip (CPU has nothing to overlap).
+
 Gates (record still prints on failure, like kv_block_bench.py):
 
 - per-``kv_limit`` greedy argmax parity, kernel vs gather
 - token-identical greedy outputs, chunked vs unchunked admission
+- token-identical greedy outputs, async vs sync serving loop
 
 Usage::
 
@@ -216,6 +224,70 @@ def _stall_ab(config, params, args):
     }
 
 
+def _async_ab(config, params, args):
+    """Sync vs async serving loop steps/sec on a mixed decode workload
+    (docs/serving.md "Async step pipeline"). The gate is greedy-output
+    parity between the loops; throughput and the host-schedule vs
+    device-wait split are reported, not gated (CPU jitter would flake —
+    the speedup column is only meaningful on a real chip, where async
+    dispatch actually overlaps host scheduling with device compute)."""
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    prompts = [
+        rng.integers(0, config.vocab_size, size=(args.short_tokens,)).tolist()
+        for _ in range(args.max_batch)
+    ]
+    gen = GenerationConfig(max_new_tokens=args.max_new_tokens)
+    buckets = [x for x in (8, 16, 32, 64, 128) if x <= args.max_seq_len]
+    num_blocks = 4 * (args.max_seq_len // args.block_size)
+
+    def run(async_loop):
+        eng = InferenceEngine(
+            config, params,
+            max_batch=args.max_batch, max_seq_len=args.max_seq_len,
+            buckets=buckets,
+        )
+        paged = PagedServingEngine(
+            eng, gen,
+            PagedConfig(
+                block_size=args.block_size, num_blocks=num_blocks,
+                async_loop=async_loop,
+            ),
+        )
+        for p in prompts:
+            paged.submit(p)
+        t0 = time.perf_counter()
+        out = paged.run_to_completion()
+        wall = time.perf_counter() - t0
+        snap = paged.metrics.snapshot()
+        return out, paged.metrics.decode_steps / wall, snap
+
+    out_sync, sync_sps, snap_sync = run(False)
+    out_async, async_sps, snap_async = run(True)
+    return {
+        "sync_steps_per_s": round(sync_sps, 2),
+        "async_steps_per_s": round(async_sps, 2),
+        "async_speedup": round(async_sps / sync_sps, 3),
+        "async_parity": out_sync == out_async,
+        "async_steps": snap_async["decode_steps_async"],
+        "lame_duck_tokens": snap_async["lame_duck_tokens"],
+        "sync_host_schedule_ms_per_step": snap_sync["host_schedule_ms_per_step"],
+        "sync_device_wait_ms_per_step": snap_sync["device_wait_ms_per_step"],
+        "async_host_schedule_ms_per_step": snap_async["host_schedule_ms_per_step"],
+        "async_device_wait_ms_per_step": snap_async["device_wait_ms_per_step"],
+    }
+
+
 def run_bench(args: argparse.Namespace) -> dict:
     import jax
 
@@ -230,6 +302,7 @@ def run_bench(args: argparse.Namespace) -> dict:
         for limit in args.kv_limit_list
     ]
     stall = _stall_ab(config, params, args)
+    loop_ab = _async_ab(config, params, args)
 
     record = {
         "bench": "paged_decode",
@@ -241,6 +314,7 @@ def run_bench(args: argparse.Namespace) -> dict:
         "iters": args.iters,
         "decode_cases": cases,
         **stall,
+        **loop_ab,
     }
     failures = []
     for c in cases:
@@ -250,6 +324,8 @@ def run_bench(args: argparse.Namespace) -> dict:
             )
     if not stall["chunked_parity"]:
         failures.append("chunked-prefill outputs diverge from unchunked")
+    if not loop_ab["async_parity"]:
+        failures.append("async serving loop outputs diverge from sync loop")
     if failures:
         record["gate_failure"] = "; ".join(failures)
     return record
