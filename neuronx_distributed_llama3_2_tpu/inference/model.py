@@ -407,15 +407,16 @@ class LlamaDecode:
                 # gather-free read: the kernel dereferences the block table
                 # inside its BlockSpec index maps, so the (b, limit, NKV, D)
                 # K/V copy below never materializes (flash-decoding split-K,
-                # kernels/paged_attention_pallas)
+                # kernels/paged_attention_pallas). Linear fresh blocks only:
+                # the kernel's block-causal mask row <= position + ti is the
+                # dense path's j <= position + t, per fresh token.
                 from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (
                     paged_flash_decode,
                 )
 
                 att = paged_flash_decode(
-                    q[:, 0], kc, vc, block_tables, pos_block[:, 0],
-                    kv_limit=limit,
-                )[:, None]
+                    q, kc, vc, block_tables, positions, kv_limit=limit,
+                )
                 att = constrain(att, P(BATCH_AXES, None, ha, None))
             else:
                 jlog = jnp.arange(limit, dtype=jnp.int32)
@@ -462,18 +463,74 @@ class LlamaDecode:
             new_positions = jnp.minimum(new_positions, pos_cap)
         return logits[:, 0, :], new_positions, cache
 
+    def verify_step(
+        self,
+        params: Params,
+        cache: PagedKVCache,
+        tokens: jax.Array,        # (b, k+1) int32 — [cur, d_0 .. d_{k-1}]
+        positions: jax.Array,     # (b,) int32 — cur's write row per lane
+        block_tables: jax.Array,  # (b, W) int32
+        draft_len: jax.Array,     # (b,) int32 — valid drafts per lane, <= k
+        *,
+        kv_limit: Optional[int] = None,
+        pos_cap: Optional[int] = None,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, PagedKVCache]:
+        """One speculative verify step: the greedy multi-token sibling of
+        :meth:`decode_step`. The candidate block ``[cur, d_0 .. d_{k-1}]``
+        is scored in ONE block-causal forward (writing its K/V at rows
+        ``positions .. positions + k``), the longest draft prefix agreeing
+        with the target's argmax is accepted on device — capped per lane by
+        ``draft_len``, so a lane with no drafts degrades to a plain decode
+        step — and the resident state advances without any host round trip.
+
+        Returns ``(emitted (b, k+1), accept (b,), new_tokens (b,),
+        new_positions (b,), cache)``: ``emitted[i, :accept[i] + 1]`` are the
+        tokens the lane commits this step (accepted drafts plus the
+        correction/bonus token), ``new_tokens[i] = emitted[i, accept[i]]``
+        is the new resident token (newest emitted, K/V not yet written —
+        the same invariant :meth:`decode_step` keeps), and
+        ``new_positions = positions + accept + 1`` is its write row.
+        Rejected rows ``> positions + accept`` need no rollback: the
+        block-causal mask never looks past the frontier, so the next step
+        simply overwrites them (the overwrite-frontier trick of
+        :mod:`.speculative`). Greedy-only: acceptance compares against
+        ``argmax``, which is exactly ``sample()`` under
+        ``SamplingConfig(greedy=True)``.
+        """
+        from neuronx_distributed_llama3_2_tpu.inference.speculative import (
+            accept_rule,
+        )
+
+        logits, cache = self.forward(
+            params, cache, tokens, positions, None,
+            block_tables=block_tables, kv_limit=kv_limit,
+        )
+        # greedy[i, j] = target's token for row positions[i] + j + 1
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        accept, emitted = accept_rule(tokens[:, 1:], greedy, draft_len=draft_len)
+        new_tokens = jnp.take_along_axis(emitted, accept[:, None], axis=1)[:, 0]
+        new_positions = positions + accept + 1
+        if pos_cap is not None:
+            new_positions = jnp.minimum(new_positions, pos_cap)
+        return emitted, accept, new_tokens, new_positions, cache
+
     def _paged_kernel_eligible(self, t: int, tree) -> bool:
         """Gate for the Pallas paged-decode kernel: the ``use_paged_kernel``
-        config opt-in, T == 1 token-gen only (suffix prefill and tree
-        verification keep the dense gather — their fresh block attends many
-        rows at once), and no multi-device mesh (``pallas_call`` is opaque to
-        the SPMD partitioner, so under tp the gather path's sharded einsums
-        stay the right choice)."""
+        config opt-in, a *linear* fresh block of at most
+        ``paged_kernel_max_t`` tokens — T == 1 token-gen, speculative verify
+        blocks, and suffix-prefill chunks that fit the bound all qualify;
+        longer prefill buckets and tree verification keep the dense gather
+        (a tree's in-block mask is its ancestor matrix, not the kernel's
+        block-causal ``row <= position + ti``) — and no multi-device mesh
+        (``pallas_call`` is opaque to the SPMD partitioner, so under tp the
+        gather path's sharded einsums stay the right choice)."""
         from neuronx_distributed_llama3_2_tpu.parallel import (
             state as parallel_state,
         )
 
-        if not self.config.use_paged_kernel or t != 1 or tree is not None:
+        if not self.config.use_paged_kernel or tree is not None:
+            return False
+        if not 1 <= t <= self.config.paged_kernel_max_t:
             return False
         if parallel_state.model_parallel_is_initialized():
             if parallel_state.get_parallel_state().mesh.size > 1:

@@ -30,6 +30,38 @@ from neuronx_distributed_llama3_2_tpu.inference.engine import (
 from neuronx_distributed_llama3_2_tpu.inference.sampling import SamplingConfig
 
 
+def accept_rule(drafts, greedy, draft_len=None):
+    """The speculative accept/reject rule (Leviathan et al. 2023, greedy
+    case), as a pure batched function shared by :class:`SpeculativeDecoder`
+    (host-side, numpy) and the paged engine's on-device verify step
+    (``LlamaDecode.verify_step``, traced).
+
+    ``drafts (..., k)``: proposed tokens; ``greedy (..., k+1)``: the
+    target's argmax over the scored block ``[cur, d_0 .. d_{k-1}]``, i.e.
+    ``greedy[..., j]`` is the target's choice for the position right after
+    draft ``j-1``. ``draft_len (...,)`` optionally caps acceptance per
+    batch row (rows with fewer than k real drafts; ``None`` = all k valid).
+
+    Returns ``(accept (...,), emitted (..., k+1))``: ``accept`` is the
+    length of the longest agreeing draft prefix and
+    ``emitted[..., :accept+1]`` the committed tokens — the accepted drafts
+    followed by the target's correction (or bonus, on full acceptance)
+    token ``greedy[..., accept]``. Entries past ``accept`` are meaningless.
+    """
+    drafts = jnp.asarray(drafts, jnp.int32)
+    greedy = jnp.asarray(greedy, jnp.int32)
+    k = drafts.shape[-1]
+    match = drafts == greedy[..., :k]
+    if draft_len is not None:
+        match = match & (jnp.arange(k, dtype=jnp.int32) < jnp.asarray(draft_len, jnp.int32)[..., None])
+    # longest all-True prefix: cumprod kills everything after the first miss
+    accept = jnp.cumprod(match.astype(jnp.int32), axis=-1).sum(axis=-1)
+    cand = jnp.concatenate([drafts, jnp.zeros_like(greedy[..., :1])], axis=-1)
+    idx = jnp.arange(k + 1, dtype=jnp.int32)
+    emitted = jnp.where(idx < accept[..., None], cand, greedy)
+    return accept, emitted
+
+
 @dataclasses.dataclass
 class SpeculativeResult:
     tokens: List[int]
@@ -126,10 +158,11 @@ class SpeculativeDecoder:
             )  # greedy[i] = target's token for position pos+i+1
 
             # 3) accept longest agreeing prefix + one correction/bonus token
-            a = 0
-            while a < g and drafts[a] == int(greedy[a]):
-                a += 1
-            emitted = drafts[:a] + [int(greedy[a])]
+            # (the shared pure rule — same function the paged engine's
+            # on-device verify step traces)
+            a_arr, em_arr = accept_rule(np.asarray(drafts)[None, :], greedy[None, :])
+            a = int(a_arr[0])
+            emitted = [int(x) for x in np.asarray(em_arr)[0, : a + 1]]
             accepted_log.append(a)
             if a == g:
                 # full acceptance: the draft loop wrote rows pos..pos+g-1

@@ -26,6 +26,13 @@ Structure (flash-decoding, Dao et al. 2023 — split-K for a single query row):
 - masking is per-lane by position (``row <= positions[lane]``), which also
   kills null-block garbage rows: the engine guarantees every row past a
   request's frontier is masked, whatever stale block the table points at.
+- multi-token queries (speculative verify / short suffix-prefill blocks,
+  static ``t <= LlamaConfig.paged_kernel_max_t``) fold the t fresh tokens
+  into the query-tile rows — the tile grows from ``(G, D)`` to
+  ``(t*G, D)`` and the mask becomes block-causal per query row
+  (``row <= positions[lane] + ti``) — so each KV block is still DMA'd
+  exactly once per (lane, head, split) and serves all t queries, instead
+  of growing the grid a dimension and re-fetching the pool t times.
 
 Interpret mode (`jax.default_backend() != "tpu"`) runs the same kernel body
 through the Pallas interpreter so the tier-1 CPU suite exercises this exact
@@ -59,15 +66,15 @@ def _ceil_div(a: int, b: int) -> int:
 
 def _decode_kernel(
     tbl_ref,   # scalar prefetch: (b, W) int32 block table (SMEM)
-    pos_ref,   # scalar prefetch: (b,) int32 query positions (SMEM)
-    q_ref,     # (G, D) — this lane/kv-head's query group
+    pos_ref,   # scalar prefetch: (b,) int32 first-fresh-query positions (SMEM)
+    q_ref,     # (t*G, D) — this lane/kv-head's t fresh query groups
     k_ref,     # (bs, D) — one pool block, fetched through the table
     v_ref,     # (bs, D)
-    o_ref,     # (G, D) f32 — per-split UNNORMALIZED accumulator
-    m_ref,     # (G, 1) f32 — per-split running max
-    l_ref,     # (G, 1) f32 — per-split denominator
+    o_ref,     # (t*G, D) f32 — per-split UNNORMALIZED accumulator
+    m_ref,     # (t*G, 1) f32 — per-split running max
+    l_ref,     # (t*G, 1) f32 — per-split denominator
     m_scr, l_scr, acc_scr,
-    *, bs: int, bps: int, nblk: int, sm_scale: float,
+    *, bs: int, bps: int, nblk: int, t: int, g: int, sm_scale: float,
 ):
     i = pl.program_id(0)          # lane
     s = pl.program_id(2)          # kv split
@@ -81,24 +88,32 @@ def _decode_kernel(
 
     lb = s * bps + j              # logical block index into the sequence
     pos = pos_ref[i]
-    # skip padding blocks past kv_limit and blocks entirely beyond this
-    # lane's position (the frontier: row pos itself was just written)
-    run = (lb < nblk) & (lb * bs <= pos)
+    # skip padding blocks past kv_limit and blocks entirely beyond the
+    # lane's LAST fresh query (the frontier: rows pos..pos+t-1 were just
+    # written; earlier queries in the tile mask the deeper rows per-row)
+    run = (lb < nblk) & (lb * bs <= pos + t - 1)
 
     @pl.when(run)
     def _compute():
-        q = q_ref[:]                               # (G, D)
+        q = q_ref[:]                               # (t*G, D)
         k = k_ref[:].astype(q.dtype)               # (bs, D)
         sc = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale                               # (G, bs) fp32
+        ) * sm_scale                               # (t*G, bs) fp32
         rows = lb * bs + lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-        mask = rows <= pos
+        # block-causal across the fresh tokens: tile row r holds query
+        # token ti = r // g, which sits at sequence row pos + ti
+        ti = lax.broadcasted_iota(jnp.int32, sc.shape, 0) // g
+        mask = rows <= pos + ti
         sc = jnp.where(mask, sc, NEG_INF)
 
         m_prev = m_scr[:, 0]
-        # `run` guarantees >= 1 valid row, so m_new is finite here
+        # every real query row keeps >= 1 valid key row (its own, written
+        # this step), so after the final block m_new is finite; a tile row
+        # fully masked within a `run` block (deeper query still ahead of
+        # this shallower row) is safe: p zeroes under the mask and the
+        # row's (m, l, acc) carry unchanged through alpha == 1
         m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1))
         alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
         p = jnp.exp(sc - m_new[:, None])
@@ -122,25 +137,34 @@ def _decode_kernel(
 
 
 def paged_flash_decode(
-    q: jax.Array,             # (b, N, D) — one query token per lane
+    q: jax.Array,             # (b, N, D) single query — or (b, t, N, D)
     k_pool: jax.Array,        # (num_blocks, bs, NKV, D) pool slice
     v_pool: jax.Array,        # (num_blocks, bs, NKV, D)
     block_tables: jax.Array,  # (b, W) int32; entries must be < num_blocks
-    positions: jax.Array,     # (b,) int32 — row of the just-written query
+    positions: jax.Array,     # (b,) int32 — row of the FIRST fresh query
     *,
     kv_limit: int | None = None,
     num_splits: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """Gather-free paged decode attention; returns (b, N, D) in q.dtype.
+    """Gather-free paged decode attention; returns q's shape in q.dtype.
 
     Logical row ``p`` of lane ``i`` lives at pool row
-    ``block_tables[i, p // bs] * bs + p % bs``; rows ``<= positions[i]`` are
-    attended, everything else (padding, null-block garbage) is masked.
-    ``kv_limit`` (static) bounds the logical rows visited, exactly like the
-    dense path. The caller guarantees ``positions[i] < kv_limit``.
+    ``block_tables[i, p // bs] * bs + p % bs``. A 3-dim q is the T == 1
+    token-gen step: rows ``<= positions[i]`` are attended. A 4-dim q is a
+    fresh block of t tokens (speculative verify / short suffix prefill)
+    written at rows ``positions[i] .. positions[i] + t - 1``; query ``ti``
+    attends rows ``<= positions[i] + ti`` (block-causal, matching the dense
+    path's ``j <= position + t`` mask). Everything else (padding,
+    null-block garbage) is masked. ``kv_limit`` (static) bounds the logical
+    rows visited, exactly like the dense path. The caller guarantees every
+    *used* query row sits below ``kv_limit``; extra query rows (bucket
+    padding, rejected draft tail) produce garbage the caller discards.
     """
-    b, n, d = q.shape
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, t, n, d = q.shape
     nb, bs, nkv, _ = k_pool.shape
     if n % nkv:
         raise ValueError(f"q heads ({n}) must be a multiple of kv heads ({nkv})")
@@ -155,7 +179,10 @@ def paged_flash_decode(
     bps = _ceil_div(nblk, splits)
     sm_scale = d ** -0.5
 
-    qg = q.reshape(b, nkv, g, d)
+    # fold the t fresh tokens into the query-tile rows: row ti*g + gi is
+    # query token ti, grouped head gi — one KV DMA serves all t queries
+    qg = q.reshape(b, t, nkv, g, d).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, nkv, t * g, d)
     grid = (b, nkv, splits, bps)
 
     def q_idx(i, h, s, j, tbl, pos):
@@ -171,36 +198,38 @@ def paged_flash_decode(
     def out_idx(i, h, s, j, tbl, pos):
         return (i, h, s, 0, 0)
 
+    tg = t * g
     kernel = functools.partial(
-        _decode_kernel, bs=bs, bps=bps, nblk=nblk, sm_scale=sm_scale
+        _decode_kernel, bs=bs, bps=bps, nblk=nblk, t=t, g=g,
+        sm_scale=sm_scale,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, None, g, d), q_idx),
+            pl.BlockSpec((None, None, tg, d), q_idx),
             pl.BlockSpec((None, bs, None, d), kv_idx),
             pl.BlockSpec((None, bs, None, d), kv_idx),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, None, g, d), out_idx),
+            pl.BlockSpec((None, None, None, tg, d), out_idx),
             # trailing singleton keeps the last-two-dims tiling legal
-            pl.BlockSpec((None, None, None, g, 1), out_idx),
-            pl.BlockSpec((None, None, None, g, 1), out_idx),
+            pl.BlockSpec((None, None, None, tg, 1), out_idx),
+            pl.BlockSpec((None, None, None, tg, 1), out_idx),
         ],
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((tg, 1), jnp.float32),
+            pltpu.VMEM((tg, 1), jnp.float32),
+            pltpu.VMEM((tg, d), jnp.float32),
         ],
     )
     o_parts, m_parts, l_parts = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((b, nkv, splits, g, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, nkv, splits, g, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b, nkv, splits, g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, splits, tg, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, splits, tg, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, splits, tg, 1), jnp.float32),
         ],
         # lane/head/split all carry independent scratch epochs (re-inited at
         # j == 0); only the innermost block dim is a true reduction
@@ -215,11 +244,13 @@ def paged_flash_decode(
 
     # flash-decoding combine: merge the per-split partial softmaxes by
     # rescaling each to the global max (log-sum-exp), then normalize once.
-    m_star = jnp.max(m_parts, axis=2, keepdims=True)       # (b,NKV,1,G,1)
+    m_star = jnp.max(m_parts, axis=2, keepdims=True)       # (b,NKV,1,tG,1)
     weight = jnp.where(
         m_parts == NEG_INF, 0.0, jnp.exp(m_parts - m_star)
-    )                                                      # (b,NKV,S,G,1)
-    l_tot = jnp.sum(weight * l_parts, axis=2)              # (b,NKV,G,1)
-    acc = jnp.sum(weight * o_parts, axis=2)                # (b,NKV,G,D)
+    )                                                      # (b,NKV,S,tG,1)
+    l_tot = jnp.sum(weight * l_parts, axis=2)              # (b,NKV,tG,1)
+    acc = jnp.sum(weight * o_parts, axis=2)                # (b,NKV,tG,D)
     out = acc / jnp.where(l_tot == 0.0, 1.0, l_tot)
-    return out.reshape(b, n, d).astype(q.dtype)
+    out = out.reshape(b, nkv, t, g, d).transpose(0, 2, 1, 3, 4)
+    out = out.reshape(b, t, n, d).astype(q.dtype)
+    return out[:, 0] if squeeze else out

@@ -87,9 +87,16 @@ class LlamaConfig:
     flash_block_kv: Optional[int] = None
     # paged serving decode: read the KV pool through the block table with
     # the Pallas flash-decoding kernel (kernels/paged_attention_pallas)
-    # instead of materializing a (b, kv_limit, NKV, D) gather; applies to
-    # T == 1 token-gen only, dense gather remains the fallback
+    # instead of materializing a (b, kv_limit, NKV, D) gather; covers
+    # T == 1 token-gen and linear fresh blocks up to paged_kernel_max_t
+    # tokens (speculative verify, short suffix-prefill chunks), dense
+    # gather remains the fallback
     use_paged_kernel: bool = False
+    # largest fresh-block length routed through the paged kernel: the t
+    # fresh tokens fold into the kernel's query-tile rows, so this bounds
+    # the (t * group) tile height; tree-masked blocks and longer prefill
+    # buckets keep the dense gather
+    paged_kernel_max_t: int = 8
     # chunk the LM head + CE over the sequence so full (B,S,V) logits never
     # materialize; None disables (loss-memory redesign, no reference analogue)
     loss_chunk_size: Optional[int] = None

@@ -9,6 +9,10 @@ from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     NULL_BLOCK,
     BlockAllocator,
 )
+from neuronx_distributed_llama3_2_tpu.serving.drafter import (
+    DraftProposer,
+    NGramDrafter,
+)
 from neuronx_distributed_llama3_2_tpu.serving.engine import (
     PagedConfig,
     PagedServingEngine,
@@ -22,6 +26,8 @@ from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
 __all__ = [
     "NULL_BLOCK",
     "BlockAllocator",
+    "DraftProposer",
+    "NGramDrafter",
     "PagedConfig",
     "PagedServingEngine",
     "RadixPrefixIndex",

@@ -1,0 +1,69 @@
+"""Weight-free draft proposers for speculative serving.
+
+The paged engine's verify step (docs/serving.md "Speculative decoding")
+accepts drafts from any :class:`DraftProposer` — the acceptance rule
+(:func:`..inference.speculative.accept_rule`) guarantees greedy output is
+token-identical to plain decoding *whatever* the drafter proposes, so a
+proposer is purely a throughput knob: good drafts multiply tokens/step,
+bad drafts cost one wasted multi-token forward.
+
+:class:`NGramDrafter` is prompt-lookup decoding (the n-gram drafter of
+vLLM/transformers "prompt lookup"): match the sequence's own trailing
+n-gram against its earlier history and propose the continuation that
+followed last time. Weight-free and per-lane, so it composes with radix
+prefix caching — repetitive traffic (code, retrieval contexts, templated
+docs) drafts well, free text mostly abstains. A small draft *model* can
+slot in later by implementing the same one-method interface against the
+draft checkpoint (reusing :class:`..inference.speculative`'s machinery).
+"""
+
+from __future__ import annotations
+
+from typing import List, Protocol, Sequence, runtime_checkable
+
+
+@runtime_checkable
+class DraftProposer(Protocol):
+    """Anything that proposes draft tokens for one lane's history."""
+
+    def propose(self, history: Sequence[int], max_tokens: int) -> List[int]:
+        """Return up to ``max_tokens`` draft tokens continuing ``history``
+        (the lane's prompt + generated tokens so far, newest last). An
+        empty list abstains — the lane takes a plain decode step."""
+        ...
+
+
+class NGramDrafter:
+    """Prompt-lookup drafting: longest-suffix n-gram match against the
+    lane's own history.
+
+    For ``n`` from ``max_n`` down to ``min_n``, find the most recent
+    earlier occurrence of the history's last ``n`` tokens and propose the
+    tokens that followed it. Larger ``n`` first: a longer match is a
+    stronger signal, and the first hit wins. Pure host-side list scanning —
+    histories are at most ``max_seq_len`` tokens, so the reverse linear
+    scan is microseconds against a multi-millisecond decode step.
+    """
+
+    def __init__(self, max_n: int = 3, min_n: int = 1) -> None:
+        if not 1 <= min_n <= max_n:
+            raise ValueError(f"need 1 <= min_n <= max_n, got ({min_n}, {max_n})")
+        self.max_n = max_n
+        self.min_n = min_n
+
+    def propose(self, history: Sequence[int], max_tokens: int) -> List[int]:
+        if max_tokens < 1:
+            return []
+        h = list(history)
+        for n in range(self.max_n, self.min_n - 1, -1):
+            if len(h) <= n:
+                continue
+            tail = h[-n:]
+            # latest earlier occurrence; the match may overlap the suffix
+            # region (periodic text), only the trailing copy itself is
+            # excluded — start + n <= len(h) - 1, so the continuation is
+            # never empty
+            for start in range(len(h) - n - 1, -1, -1):
+                if h[start : start + n] == tail:
+                    return h[start + n : start + n + max_tokens]
+        return []

@@ -97,6 +97,28 @@ class PagedConfig:
     # for greedy sampling; EOS/max-len detection lags one step and the
     # extra "lame-duck" token is discarded.
     async_loop: bool = False
+    # speculative decoding (docs/serving.md "Speculative decoding"): draft
+    # up to this many tokens per lane per step and verify them in ONE
+    # multi-token forward — accepted drafts multiply tokens/step. 0 = off.
+    # Greedy sampling only (acceptance compares the target's argmax).
+    spec_draft_tokens: int = 0
+    # n-gram window of the default prompt-lookup drafter (serving/drafter.py)
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
+    # draft-disable heuristic: once a request has been offered at least
+    # spec_probation_tokens drafts, it drops to plain decode for good when
+    # its personal accept rate sits below spec_min_accept_rate (counted in
+    # ServingMetrics.spec_disabled_lanes) — a lane the drafter keeps
+    # guessing wrong on should not pay the verify-width forward
+    spec_min_accept_rate: float = 0.2
+    spec_probation_tokens: int = 32
+    # verify steps need same-step readback (the accept length decides how
+    # far each lane advanced), so drafting runs in the synchronous loop;
+    # when the drafter abstains for every lane, the async lookahead runs
+    # instead and drafting is re-tried after this many steps. 0 = re-try
+    # every step (the async pipeline only runs when speculation is off or
+    # every active request is spec-disabled).
+    spec_retry_steps: int = 4
 
 
 @dataclasses.dataclass
@@ -120,6 +142,11 @@ class _PagedRequest:
     # of this admission (the table is fixed for the whole chunk walk, so it
     # uploads once, not once per chunk); dropped on install/preempt/finish
     table_dev: Any = None
+    # speculative decoding: per-request acceptance telemetry driving the
+    # draft-disable heuristic (PagedConfig.spec_min_accept_rate)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_disabled: bool = False
 
 
 class PagedServingEngine:
@@ -134,6 +161,7 @@ class PagedServingEngine:
         gen: GenerationConfig = GenerationConfig(),
         paged: PagedConfig = PagedConfig(),
         precompile: bool = True,
+        drafter: Optional[Any] = None,
     ) -> None:
         self.engine = engine
         self.model = engine.model
@@ -146,6 +174,28 @@ class PagedServingEngine:
             # a solo request's re-admission after self-preemption is only
             # guaranteed to fit when admission kept >= 1 block of headroom
             raise ValueError("decode_reserve_blocks must be >= 1")
+        self._spec_k = int(paged.spec_draft_tokens or 0)
+        if self._spec_k < 0:
+            raise ValueError("spec_draft_tokens must be >= 0")
+        if self._spec_k and not gen.sampling.greedy:
+            # acceptance compares the target's argmax; a sampled stream
+            # would silently stop matching the plain loop
+            raise ValueError(
+                "speculative serving requires greedy sampling "
+                "(SamplingConfig(greedy=True))"
+            )
+        self.drafter = drafter
+        if self._spec_k and self.drafter is None:
+            from neuronx_distributed_llama3_2_tpu.serving.drafter import (
+                NGramDrafter,
+            )
+
+            self.drafter = NGramDrafter(
+                max_n=paged.spec_ngram_max, min_n=paged.spec_ngram_min
+            )
+        # steps left before the next draft attempt while the async
+        # lookahead owns the loop (PagedConfig.spec_retry_steps)
+        self._spec_pause = 0
         # suffix prefill must route any length <= max_seq_len even when the
         # bucket ladder tops out early (dense decode has the same fallback)
         self._prefill_buckets = list(engine.buckets)
@@ -158,6 +208,15 @@ class PagedServingEngine:
         self.table_width = _ceil_div(engine.max_seq_len, bs) + _ceil_div(
             self._prefill_buckets[-1], bs
         )
+        if self._spec_k and engine.max_seq_len + self._spec_k > self.table_width * bs:
+            # verify writes reach row position + k; the overflow table
+            # region (always null-backed) must absorb the rejected tail of
+            # a lane sitting at the sequence cap
+            raise ValueError(
+                f"spec_draft_tokens ({self._spec_k}) exceeds the table's "
+                f"overflow region ({self.table_width * bs - engine.max_seq_len} "
+                f"rows past max_seq_len)"
+            )
         self.cache = self.model.init_paged_cache(
             paged.num_blocks, bs, paged.cache_dtype
         )
@@ -300,6 +359,32 @@ class PagedServingEngine:
                 kv_limit=kv_limit, pos_cap=pos_cap,
             )
             return sample(logits, key, cfg), new_positions, cache
+
+        self._programs[key_] = jax.jit(fn, donate_argnums=(1, 3))
+        return self._programs[key_]
+
+    def _verify_program(self, kv_limit: int, k: int):
+        """Speculative verify: score the per-lane candidate block
+        ``[resident token, d_0 .. d_{k-1}]`` in one T = k+1 forward and
+        advance the resident state by the on-device accept length
+        (``LlamaDecode.verify_step``). Cache and positions are donated like
+        the plain decode program; the resident token array is not (it may
+        still be a pending readback source) — the fresh drafts ride in as a
+        separate (B, k) upload, the ONLY per-step host→device traffic
+        speculation adds."""
+        key_ = ("pverify", kv_limit, k)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model, engine = self.model, self.engine
+        pos_cap = self._pos_cap
+
+        def fn(params, cache, tokens, positions, tables, drafts, draft_len):
+            params = engine._live_params(params)
+            block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            return model.verify_step(
+                params, cache, block, positions, tables, draft_len,
+                kv_limit=kv_limit, pos_cap=pos_cap,
+            )
 
         self._programs[key_] = jax.jit(fn, donate_argnums=(1, 3))
         return self._programs[key_]
@@ -845,6 +930,12 @@ class PagedServingEngine:
         the token readback."""
         self._admit()
         self._advance_prefills()
+        return self._dispatch_sync_decode()
+
+    def _dispatch_sync_decode(self) -> bool:
+        """The decode tail of a synchronous step (shared with the
+        speculative step's plain-decode fallback): back the write rows,
+        flush lane state, dispatch one T=1 step and read it back."""
         if not any(not r.prefilling for r in self._active.values()):
             return bool(self._active or self._queue)
         self._ensure_decode_blocks()
@@ -872,7 +963,155 @@ class PagedServingEngine:
         self._read_and_apply((toks, decode_lanes, self._dispatch_count))
         return bool(self._active or self._queue)
 
+    # -- speculative decoding ----------------------------------------------
+
+    def _collect_drafts(self) -> Dict[int, List[int]]:
+        """Ask the drafter for up to ``spec_draft_tokens`` proposals per
+        decode-ready lane. A lane abstains when the drafter finds nothing,
+        when it is spec-disabled (low accept rate past probation), or when
+        fewer than two tokens remain (a plain step finishes it anyway).
+        Draft counts are clamped so acceptance can never overshoot
+        ``max_new_tokens`` — with the submit() capacity invariant that also
+        keeps every committed row below ``max_seq_len``."""
+        k = self._spec_k
+        out: Dict[int, List[int]] = {}
+        for lane, req in self._active.items():
+            if req.prefilling or req.spec_disabled:
+                continue
+            remaining = self.gen.max_new_tokens - len(req.out)
+            limit = min(k, remaining - 1)
+            if limit < 1:
+                continue
+            drafts = self.drafter.propose(req.prompt + req.out, limit)
+            if drafts:
+                out[lane] = list(drafts[:limit])
+        return out
+
+    def _prepare_spec_blocks(self, proposals: Dict[int, List[int]]) -> None:
+        """Back each drafting lane's verify-write rows (``position ..
+        position + draft_len``) with real blocks WITHOUT preempting:
+        evicting cached LRU blocks is fine, but when the pool runs dry the
+        lane's draft is trimmed to the rows already backed (down to a plain
+        decode) — speculation is a throughput bet, never worth bumping an
+        active request. Rows past ``draft_len`` stay null-backed: their
+        garbage writes land in the null block and ``accept <= draft_len``
+        keeps every accepted query inside the backed frontier."""
+        bs = self.paged.block_size
+        for lane in sorted(proposals):
+            req = self._active[lane]
+            need = (int(self._positions[lane]) + len(proposals[lane])) // bs + 1
+            while len(req.table) < need:
+                nb = self.allocator.alloc()
+                if nb is None:
+                    break
+                self._append_block(lane, req, nb)
+            backed = len(req.table) * bs - 1 - int(self._positions[lane])
+            if backed < len(proposals[lane]):
+                if backed < 1:
+                    del proposals[lane]
+                else:
+                    proposals[lane] = proposals[lane][:backed]
+
+    def _step_spec(self) -> tuple:
+        """One synchronous step whose decode dispatch is a multi-token
+        verify (``LlamaDecode.verify_step``): every decode lane rides the
+        same T = k+1 program — drafting lanes advance by their on-device
+        accept length + 1, lanes whose drafter abstained carry
+        ``draft_len 0`` and take what is exactly a plain greedy decode
+        step. Verify needs same-step readback (the accept length decides
+        how far each lane's host state advances), so this path never
+        overlaps the async lookahead — the pipeline is drained before
+        entry. Returns ``(alive, drafted)``; with no proposals at all the
+        step falls through to the plain sync decode."""
+        self._admit()
+        self._advance_prefills()
+        proposals = self._collect_drafts()
+        if proposals:
+            self._prepare_spec_blocks(proposals)
+        if proposals:
+            self._ensure_decode_blocks()
+            # base-row backing may have preempted drafting lanes (youngest
+            # first); their proposals die with them
+            proposals = {
+                l: d for l, d in proposals.items()
+                if self._active.get(l) is not None
+                and not self._active[l].prefilling
+            }
+        if not proposals:
+            return self._dispatch_sync_decode(), False
+        decode_lanes = [
+            l for l, r in self._active.items() if not r.prefilling
+        ]
+        self._flush_state()
+        eng = self.engine
+        k = self._spec_k
+        drafts = np.zeros((eng.max_batch, k), np.int32)
+        draft_len = np.zeros((eng.max_batch,), np.int32)
+        for lane, d in proposals.items():
+            drafts[lane, : len(d)] = d
+            draft_len[lane] = len(d)
+        kv_limit = eng._kv_bucket(
+            int(max(self._positions[l] for l in decode_lanes)) + k + 1
+        )
+        fn = self._verify_program(kv_limit, k)
+        emitted_d, accept_d, new_tokens, self._d_positions, self.cache = fn(
+            eng.params, self.cache,
+            self._d_tokens, self._d_positions, self._d_tables,
+            self._upload(drafts), self._upload(draft_len),
+        )
+        self._d_tokens = new_tokens
+        self._dispatch_count += 1
+        self.metrics.decode_steps += 1
+        self.metrics.verify_steps += 1
+        self.metrics.draft_tokens += int(draft_len.sum())
+        emitted = self._read_tokens(emitted_d)      # (B, k+1)
+        accept = self._read_tokens(accept_d)        # (B,)
+        self._last_readback_lag = 0
+        cfg = self.paged
+        finishing: List[_PagedRequest] = []
+        for lane in decode_lanes:
+            req = self._active[lane]
+            a = int(accept[lane])
+            self.metrics.accepted_tokens += a
+            req.spec_drafted += int(draft_len[lane])
+            req.spec_accepted += a
+            self._positions[lane] += a + 1  # mirror the on-device advance
+            for j in range(a + 1):
+                req.out.append(int(emitted[lane, j]))
+                req.position += 1
+                self._tokens[lane] = emitted[lane, j]
+                if req.position >= eng.max_seq_len - 1:
+                    req.done = True
+                if self._finish_due(req):
+                    # EOS (or a cap) inside the accepted run: the committed
+                    # device rows past it are moot — the finish path resets
+                    # the lane and reconciles host/device state
+                    break
+            if self._finish_due(req):
+                finishing.append(req)
+            elif (
+                not req.spec_disabled
+                and req.spec_drafted >= cfg.spec_probation_tokens
+                and req.spec_accepted < cfg.spec_min_accept_rate * req.spec_drafted
+            ):
+                req.spec_disabled = True
+                self.metrics.spec_disabled_lanes += 1
+        for req in finishing:
+            self._maybe_finish(req)
+        return bool(self._active or self._queue), True
+
     def _step_inner(self) -> bool:
+        if self._spec_k and self._spec_pause <= 0:
+            self._drain_pending()
+            alive, drafted = self._step_spec()
+            # a dry drafter hands the loop to the async lookahead for a few
+            # steps (spec_retry_steps) instead of pinning it to sync mode;
+            # with async off there is nothing to yield to — retry every step
+            if not drafted and self.paged.async_loop:
+                self._spec_pause = self.paged.spec_retry_steps
+            return alive
+        if self._spec_pause > 0:
+            self._spec_pause -= 1
         if self.paged.async_loop and self._async_eligible():
             if self._ensure_decode_blocks_async():
                 return self._step_async()
@@ -933,14 +1172,18 @@ def make_serving_engine(
     gen: GenerationConfig = GenerationConfig(),
     paged: Optional[PagedConfig] = None,
     precompile: bool = True,
+    drafter: Optional[Any] = None,
 ):
     """The serving-path config flag: ``paged=None`` keeps the dense
     slot-scheduled engine; a :class:`PagedConfig` opts into the block pool
-    + radix prefix caching."""
+    + radix prefix caching (``drafter`` overrides the default n-gram
+    proposer when ``spec_draft_tokens`` is set)."""
     if paged is None:
         from neuronx_distributed_llama3_2_tpu.inference.engine import (
             ContinuousBatchingEngine,
         )
 
         return ContinuousBatchingEngine(engine, gen, precompile=precompile)
-    return PagedServingEngine(engine, gen, paged, precompile=precompile)
+    return PagedServingEngine(
+        engine, gen, paged, precompile=precompile, drafter=drafter
+    )

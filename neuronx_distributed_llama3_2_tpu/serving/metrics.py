@@ -44,11 +44,21 @@ class ServingMetrics:
     # -- step-phase timing (monotonic clock around dispatch/readback) --
     host_schedule_ms: float = 0.0  # cumulative step time minus device waits
     device_wait_ms: float = 0.0    # cumulative blocking token-readback time
+    # -- speculative decoding (docs/serving.md "Speculative decoding") --
+    draft_tokens: int = 0          # drafts offered to verify steps
+    accepted_tokens: int = 0       # drafts the target's argmax agreed with
+    verify_steps: int = 0          # of decode_steps, multi-token verifies
+    spec_disabled_lanes: int = 0   # requests dropped to plain decode (low
+    #                                accept rate past probation)
 
     def prefix_skip_fraction(self) -> float:
         """Fraction of admitted prompt tokens that skipped prefill."""
         total = self.prefill_tokens + self.cached_tokens
         return self.cached_tokens / total if total else 0.0
+
+    def accept_rate(self) -> float:
+        """Fraction of offered draft tokens the target accepted."""
+        return self.accepted_tokens / self.draft_tokens if self.draft_tokens else 0.0
 
     def snapshot(
         self,
@@ -57,6 +67,7 @@ class ServingMetrics:
     ) -> dict:
         rec = dataclasses.asdict(self)
         rec["prefix_skip_fraction"] = round(self.prefix_skip_fraction(), 4)
+        rec["accept_rate"] = round(self.accept_rate(), 4)
         rec["host_schedule_ms"] = round(self.host_schedule_ms, 3)
         rec["device_wait_ms"] = round(self.device_wait_ms, 3)
         steps = max(self.decode_steps, 1)

@@ -74,18 +74,21 @@ def _case(name, b, s, n, nkv, d, causal, segments, seed, block_q, block_kv):
     return ok
 
 
-def _paged_case(name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed):
+def _paged_case(name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed, t=1):
     """Paged flash-decode kernel vs the dense block-table gather reference.
 
     Forward-only (the decode kernel has no backward; serving never
     differentiates through it). bf16 pool + queries, like serving decode.
+    ``t == 1`` exercises the 3-dim single-token API; ``t > 1`` the 4-dim
+    multi-token verify path with its block-causal mask (speculative decode).
     """
     from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (
         paged_flash_decode,
     )
 
     ks = jax.random.split(jax.random.key(seed), 3)
-    q = (jax.random.normal(ks[0], (b, n, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    qshape = (b, n, d) if t == 1 else (b, t, n, d)
+    q = (jax.random.normal(ks[0], qshape, jnp.float32) * 0.5).astype(jnp.bfloat16)
     kp = (jax.random.normal(ks[1], (nb, bs, nkv, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
     vp = (jax.random.normal(ks[2], (nb, bs, nkv, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
     rng = np.random.default_rng(seed)
@@ -95,25 +98,33 @@ def _paged_case(name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed):
     for i in range(b):
         tables[i, :nblk] = perm[i * nblk:(i + 1) * nblk]
     tables = jnp.asarray(tables)
+    # positions = row of the FIRST fresh query; row 0 pinned to the edge so
+    # the last query attends exactly kv_limit rows
     positions = jnp.asarray(
-        rng.integers(0, kv_limit, size=(b,)), jnp.int32
-    ).at[0].set(kv_limit - 1)
+        rng.integers(0, kv_limit - t + 1, size=(b,)), jnp.int32
+    ).at[0].set(kv_limit - t)
 
     def ref(q, kp, vp):
         # dense gather: exactly what the kernel replaces
         g = n // nkv
+        q4 = q[:, None] if t == 1 else q                # (b, t, n, d)
         jlog = jnp.arange(kv_limit)
         phys = tables[:, jlog // bs] * bs + (jlog % bs)
         kf = kp.reshape(nb * bs, nkv, d)[phys]          # (b, L, nkv, d)
         vf = vp.reshape(nb * bs, nkv, d)[phys]
-        qg = q.reshape(b, nkv, g, d).astype(jnp.float32)
-        logits = jnp.einsum("bhgd,blhd->bhgl", qg, kf.astype(jnp.float32))
+        qg = q4.reshape(b, t, nkv, g, d).astype(jnp.float32)
+        logits = jnp.einsum("bthgd,blhd->bthgl", qg, kf.astype(jnp.float32))
         logits = logits / jnp.sqrt(jnp.float32(d))
-        mask = (jlog[None, :] <= positions[:, None])[:, None, None, :]
+        # block-causal: query row ti sees logical rows <= positions + ti
+        mask = (
+            jlog[None, None, :]
+            <= positions[:, None, None] + jnp.arange(t)[None, :, None]
+        )[:, :, None, None, :]
         logits = jnp.where(mask, logits, -jnp.inf)
         p = jax.nn.softmax(logits, axis=-1)
-        o = jnp.einsum("bhgl,blhd->bhgd", p, vf.astype(jnp.float32))
-        return o.reshape(b, n, d)
+        o = jnp.einsum("bthgl,blhd->bthgd", p, vf.astype(jnp.float32))
+        o = o.reshape(b, t, n, d)
+        return o[:, 0] if t == 1 else o
 
     o_k = jax.jit(
         lambda q, kp, vp: paged_flash_decode(
@@ -146,11 +157,15 @@ def main() -> int:
     ok = True
     for c in cases:
         ok &= _case(*c)
-    #          name            b  n  nkv d   nb  bs  w  L    splits seed
+    #          name            b  n  nkv d   nb  bs  w  L    splits seed  t
     paged_cases = [
         ("paged-gqa",          4, 8, 2, 64, 33, 16, 8, 128, 4, 10),
         ("paged-mha",          2, 4, 4, 64, 17, 16, 4, 64,  2, 11),
         ("paged-ragged-limit", 3, 8, 2, 64, 33, 16, 8, 100, 4, 12),
+        # multi-token verify queries (speculative decoding)
+        ("paged-verify-t2",    4, 8, 2, 64, 33, 16, 8, 128, 4, 13, 2),
+        ("paged-verify-t4",    3, 8, 2, 64, 33, 16, 8, 100, 2, 14, 4),
+        ("paged-verify-t8",    2, 4, 4, 64, 17, 16, 4, 64,  1, 15, 8),
     ]
     for c in paged_cases:
         ok &= _paged_case(*c)

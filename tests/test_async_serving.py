@@ -184,6 +184,59 @@ def test_soak_randomized_schedule_token_identical(params):
     assert m.prefill_chunks > 0  # ... and chunked prefill
 
 
+@pytest.mark.parametrize("model_cfg", [TINY, TINY_KERNEL], ids=["gather", "kernel"])
+@pytest.mark.parametrize("chunk", [None, 8], ids=["whole", "chunked"])
+def test_soak_spec_randomized_schedule(params, model_cfg, chunk):
+    """Speculative variant of the soak: the same randomized arrival driving
+    with the n-gram drafter on (async loop, tight pool), across gather/
+    kernel × whole/chunked prefill. Greedy recompute determinism makes the
+    uncontended dense run the reference — whatever interleaving of verify
+    steps, dry-spell plain steps, and preempt-resumes the schedule causes,
+    the outputs must be token-identical and the pool must drain."""
+    rng = np.random.default_rng(99)
+    gen = GenerationConfig(max_new_tokens=14)
+    cfg = dict(
+        block_size=4, num_blocks=24, decode_reserve_blocks=1,
+        prefill_chunk_tokens=chunk, async_loop=True, spec_draft_tokens=4,
+    )
+    n_requests = 14
+    lengths = rng.integers(3, 32, size=n_requests)
+    # repetitive/free-text mix: even lanes draft well, odd lanes abstain
+    free = iter(_prompts(rng, lengths))
+    prompts = []
+    for i, n in enumerate(lengths):
+        plain = next(free)
+        if i % 2 == 0:
+            pat = rng.integers(1, 9, size=3).tolist()
+            prompts.append((pat * (int(n) // 3 + 1))[: int(n)])
+        else:
+            prompts.append(plain)
+    arrivals = np.sort(rng.integers(0, 80, size=n_requests)).tolist()
+
+    paged = _paged(
+        params, gen, PagedConfig(**cfg), model_cfg,
+        max_seq_len=64, buckets=[8, 16, 32],
+    )
+    steps, next_req = 0, 0
+    alive = True
+    while alive or next_req < n_requests:
+        while next_req < n_requests and arrivals[next_req] <= steps:
+            paged.submit(prompts[next_req])
+            next_req += 1
+        alive = paged.step()
+        steps += 1
+        assert steps < 3000, "spec soak did not converge"
+    assert paged._pending is None
+    assert paged.allocator.active_blocks == 0
+    assert paged.metrics.finished == n_requests
+    out = {r: paged._finished[r].out for r in sorted(paged._finished)}
+    assert out == _dense_outputs(params, prompts, gen)
+    m = paged.metrics
+    assert m.verify_steps > 0
+    assert m.accepted_tokens > 0
+    assert m.preemptions > 0  # the schedule actually exercised preemption
+
+
 def test_async_metrics_in_snapshot(params):
     gen = GenerationConfig(max_new_tokens=6)
     paged = _paged(

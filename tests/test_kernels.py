@@ -246,3 +246,64 @@ def test_paged_decode_bf16_pool_fp32_query():
         positions, kv_limit=64,
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def _paged_decode_ref_mt(q, kp, vp, tables, positions, kv_limit):
+    """Multi-token dense-gather reference: query token ti of lane i sits at
+    row positions[i] + ti and attends rows <= positions[i] + ti (the dense
+    path's block-causal ``j <= position + t``)."""
+    nb, bs, nkv, d = kp.shape
+    b, t, n, _ = q.shape
+    jlog = jnp.arange(kv_limit)
+    phys = tables[:, jlog // bs] * bs + (jlog % bs)[None, :]
+    k_all = kp.reshape(nb * bs, nkv, d)[phys]  # (b, limit, NKV, D)
+    v_all = vp.reshape(nb * bs, nkv, d)[phys]
+    g = n // nkv
+    qg = q.reshape(b, t, nkv, g, d)
+    sc = jnp.einsum("bskd,btkgd->btkgs", k_all, qg) * (d ** -0.5)
+    mask = (
+        jlog[None, None, :]
+        <= positions[:, None, None] + jnp.arange(t)[None, :, None]
+    )  # (b, t, limit)
+    sc = jnp.where(mask[:, :, None, None, :], sc, -jnp.inf)
+    p = jax.nn.softmax(sc, axis=-1)
+    return jnp.einsum("btkgs,bskd->btkgd", p, v_all).reshape(q.shape)
+
+
+@pytest.mark.parametrize("kv_limit", [64, 128, 256])
+@pytest.mark.parametrize("t", [2, 4, 8])
+def test_paged_decode_multi_token_matches_reference(t, kv_limit):
+    """Speculative-verify geometry: a linear fresh block of t tokens folded
+    into the query tile must match the block-causal dense gather for every
+    (t, kv_limit) the serving verify path can dispatch."""
+    b, n, nkv, d, nb, bs, w = 3, 4, 2, 8, 48, 8, 32
+    rng = np.random.default_rng(100 + t)
+    _, kp, vp, tables = _paged_pool(b, n, nkv, d, nb, bs, w, seed=t)
+    q = jnp.asarray(rng.standard_normal((b, t, n, d)), jnp.float32)
+    # first-fresh-token rows hitting block start / mid-block / near the end
+    positions = jnp.asarray(
+        [0, (kv_limit // 2) + 1, kv_limit - t], jnp.int32
+    )
+    ref = _paged_decode_ref_mt(q, kp, vp, tables, positions, kv_limit)
+    for num_splits in (1, 4):
+        out = paged_flash_decode(
+            q, kp, vp, tables, positions,
+            kv_limit=kv_limit, num_splits=num_splits,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_t1_four_dim_equals_three_dim():
+    """The 4-dim (b, 1, N, D) entry point is exactly the legacy 3-dim call:
+    same kernel, same mask, shape-only difference."""
+    b, n, nkv, d, nb, bs, w = 2, 4, 2, 8, 16, 8, 8
+    q, kp, vp, tables = _paged_pool(b, n, nkv, d, nb, bs, w, seed=9)
+    positions = jnp.asarray([5, 50], jnp.int32)
+    out3 = paged_flash_decode(q, kp, vp, tables, positions, kv_limit=64)
+    out4 = paged_flash_decode(
+        q[:, None], kp, vp, tables, positions, kv_limit=64
+    )
+    assert out4.shape == (b, 1, n, d)
+    np.testing.assert_allclose(
+        np.asarray(out4[:, 0]), np.asarray(out3), atol=1e-6
+    )

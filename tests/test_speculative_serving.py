@@ -1,0 +1,352 @@
+"""Speculative decoding on the paged serving engine.
+
+The contract under test (docs/serving.md "Speculative decoding"):
+
+- the accept/reject rule is a pure shared function
+  (:func:`..inference.speculative.accept_rule`) whose greedy output is
+  provably identical to plain greedy decoding, whatever the drafts;
+- the engine's verify step is token-identical to the non-speculative loop
+  across the whole matrix (gather/kernel × chunked/whole prefill ×
+  sync/async), including under preemption, and drains the block pool;
+- the verify-step program reads the KV pool gather-free when the kernel
+  is enabled (jaxpr walk), and the PR 4 steady-state residency property
+  survives speculation — a verify step's only extra host→device traffic
+  is the draft upload itself.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.inference import (
+    GenerationConfig,
+    InferenceEngine,
+)
+from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
+from neuronx_distributed_llama3_2_tpu.inference.speculative import accept_rule
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LLAMA_CONFIGS,
+    LlamaForCausalLM,
+)
+from neuronx_distributed_llama3_2_tpu.serving import (
+    NGramDrafter,
+    PagedConfig,
+    PagedServingEngine,
+)
+
+from tests.test_paged_serving import _dense_outputs, _prompts
+
+TINY = LLAMA_CONFIGS["tiny"]
+TINY_KERNEL = dataclasses.replace(TINY, use_paged_kernel=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return LlamaForCausalLM(TINY).init(jax.random.key(0))
+
+
+def _rep_prompts(rng, lengths, period=3):
+    """Repetitive prompts (short repeated n-gram pattern) so the
+    prompt-lookup drafter actually proposes."""
+    out = []
+    for n in lengths:
+        pat = rng.integers(1, 9, size=period).tolist()
+        out.append((pat * (n // period + 1))[:n])
+    return out
+
+
+def _paged(params, gen, paged_cfg, model_cfg=TINY, drafter=None):
+    eng = InferenceEngine(
+        model_cfg, params, max_batch=4, max_seq_len=64, buckets=[8, 16, 32]
+    )
+    return PagedServingEngine(eng, gen, paged_cfg, drafter=drafter)
+
+
+def _run(paged, prompts):
+    for p in prompts:
+        paged.submit(p)
+    out = paged.run_to_completion()
+    assert paged._pending is None
+    assert paged.allocator.active_blocks == 0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# accept_rule: the shared pure accept/reject function
+# ---------------------------------------------------------------------------
+
+
+def _accept_ref(drafts, greedy, draft_len):
+    """The rule as the obvious per-row python loop (the form previously
+    inlined in SpeculativeDecoder.generate)."""
+    a = 0
+    while a < draft_len and drafts[a] == greedy[a]:
+        a += 1
+    return a, list(drafts[:a]) + [greedy[a]]
+
+
+def test_accept_rule_greedy_parity():
+    """Direct unit test: for random drafts/targets the batched rule equals
+    the sequential greedy accept loop row by row — emitted[:accept+1] is
+    the accepted prefix plus the target's correction/bonus token."""
+    rng = np.random.default_rng(0)
+    k = 4
+    drafts = rng.integers(0, 5, size=(64, k)).astype(np.int32)
+    greedy = rng.integers(0, 5, size=(64, k + 1)).astype(np.int32)
+    dlen = rng.integers(0, k + 1, size=(64,)).astype(np.int32)
+    accept, emitted = accept_rule(drafts, greedy, draft_len=dlen)
+    accept, emitted = np.asarray(accept), np.asarray(emitted)
+    for i in range(64):
+        a_ref, em_ref = _accept_ref(
+            drafts[i].tolist(), greedy[i].tolist(), int(dlen[i])
+        )
+        assert accept[i] == a_ref
+        assert emitted[i, : a_ref + 1].tolist() == em_ref
+    # no cap: full-k acceptance reachable
+    accept2, emitted2 = accept_rule(drafts, drafts_to_greedy := np.concatenate(
+        [drafts, greedy[:, -1:]], axis=1
+    ))
+    assert (np.asarray(accept2) == k).all()
+    assert (np.asarray(emitted2) == drafts_to_greedy).all()
+
+
+def test_accept_rule_is_traceable():
+    """The engine traces the rule inside the jitted verify program — it
+    must stay functional under jit with no host round trips."""
+    fn = jax.jit(lambda d, g, n: accept_rule(d, g, draft_len=n))
+    a, e = fn(
+        jnp.asarray([[7, 8, 9]], jnp.int32),
+        jnp.asarray([[7, 8, 1, 2]], jnp.int32),
+        jnp.asarray([2], jnp.int32),
+    )
+    assert int(a[0]) == 2  # third match blocked by draft_len
+    assert np.asarray(e)[0, :3].tolist() == [7, 8, 1]
+
+
+# ---------------------------------------------------------------------------
+# NGramDrafter: prompt-lookup proposals
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_drafter_proposes_continuation():
+    d = NGramDrafter(max_n=3, min_n=1)
+    # last 3-gram (4,5,6) occurred earlier, followed by 7, 8
+    assert d.propose([1, 4, 5, 6, 7, 8, 2, 4, 5, 6], 2) == [7, 8]
+    # longest n wins: the 1-gram match (…,3,9) would propose 9, but the
+    # 2-gram (2,3)->4 is the stronger signal
+    assert d.propose([2, 3, 4, 1, 3, 9, 2, 3], 1) == [4]
+
+
+def test_ngram_drafter_abstains():
+    d = NGramDrafter(max_n=3, min_n=2)
+    assert d.propose([1, 2, 3, 4, 5], 4) == []  # no repeated 2/3-gram
+    assert d.propose([1, 2], 4) == []           # history too short
+    assert d.propose([1, 2, 1, 2], 0) == []     # no budget
+
+
+def test_ngram_drafter_latest_occurrence_wins():
+    d = NGramDrafter(max_n=2, min_n=2)
+    # (1,2) occurs twice; the LATER one (followed by 9) is the prediction
+    assert d.propose([1, 2, 5, 1, 2, 9, 3, 1, 2], 1) == [9]
+
+
+# ---------------------------------------------------------------------------
+# engine: greedy parity across the matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("model_cfg", [TINY, TINY_KERNEL], ids=["gather", "kernel"])
+@pytest.mark.parametrize("chunk", [None, 6], ids=["whole", "chunked"])
+def test_spec_parity_matrix(params, model_cfg, chunk):
+    """Speculative greedy serving == dense engine, with/without the paged
+    kernel and chunked prefill — and speculation must actually fire."""
+    gen = GenerationConfig(max_new_tokens=10)
+    prompts = _rep_prompts(np.random.default_rng(3), (12, 22, 9, 17))
+    cfg = dict(block_size=8, num_blocks=64, prefill_chunk_tokens=chunk)
+    want = _dense_outputs(params, prompts, gen)
+    paged = _paged(
+        params, gen, PagedConfig(**cfg, spec_draft_tokens=4), model_cfg
+    )
+    out = _run(paged, prompts)
+    assert out == want
+    m = paged.metrics
+    assert m.verify_steps > 0
+    assert m.accepted_tokens > 0
+    assert 0.0 < m.accept_rate() <= 1.0
+
+
+def test_spec_parity_async_loop(params):
+    """spec + async_loop: verify steps run synchronously (drained pipeline)
+    while dry stretches hand the loop back to the async lookahead — output
+    must stay identical to the plain sync loop."""
+    gen = GenerationConfig(max_new_tokens=12)
+    rng = np.random.default_rng(5)
+    # mixed traffic: two repetitive prompts (draft well), two random ones
+    prompts = _rep_prompts(rng, (12, 18)) + _prompts(rng, (9, 14))
+    cfg = dict(block_size=8, num_blocks=64)
+    want = _run(_paged(params, gen, PagedConfig(**cfg)), prompts)
+    paged = _paged(
+        params, gen,
+        PagedConfig(**cfg, async_loop=True, spec_draft_tokens=4),
+    )
+    out = _run(paged, prompts)
+    assert out == want
+    assert paged.metrics.verify_steps > 0
+
+
+def test_spec_parity_under_preemption(params):
+    """Pool exhaustion while speculating: spec-row backing never preempts
+    (drafts trim instead), base-row backing still does — outputs must
+    match the uncontended dense run exactly."""
+    gen = GenerationConfig(max_new_tokens=36)
+    prompts = _rep_prompts(np.random.default_rng(11), (12, 10, 14, 9))
+    cfg = dict(block_size=8, num_blocks=10, decode_reserve_blocks=1)
+    want = _dense_outputs(params, prompts, gen)
+    paged = _paged(params, gen, PagedConfig(**cfg, spec_draft_tokens=4))
+    out = _run(paged, prompts)
+    assert out == want
+    assert paged.metrics.preemptions > 0
+    assert paged.metrics.verify_steps > 0
+
+
+class _WrongDrafter:
+    """Adversarial proposer: always drafts a token the tiny model is very
+    unlikely to emit — accept rate ~0, exercising the disable heuristic."""
+
+    def propose(self, history, max_tokens):
+        return [int(TINY.vocab_size - 1)] * max_tokens
+
+
+def test_spec_disable_heuristic_and_parity(params):
+    """A hopeless drafter costs verify width for a while, then every lane
+    drops to plain decode (spec_disabled_lanes) — and the output is STILL
+    token-identical (the accept rule never admits a wrong token)."""
+    gen = GenerationConfig(max_new_tokens=24)
+    prompts = _prompts(np.random.default_rng(2), (6, 11, 9))
+    want = _dense_outputs(params, prompts, gen)
+    paged = _paged(
+        params, gen,
+        PagedConfig(
+            block_size=8, num_blocks=64, spec_draft_tokens=4,
+            spec_probation_tokens=8, spec_min_accept_rate=0.2,
+        ),
+        drafter=_WrongDrafter(),
+    )
+    out = _run(paged, prompts)
+    assert out == want
+    m = paged.metrics
+    assert m.spec_disabled_lanes == len(prompts)
+    assert m.accept_rate() < 0.2
+    # after disabling, plain decode finished the requests
+    assert m.decode_steps > m.verify_steps
+
+
+def test_spec_requires_greedy(params):
+    from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+        SamplingConfig,
+    )
+
+    gen = GenerationConfig(
+        max_new_tokens=4,
+        sampling=SamplingConfig(greedy=False, temperature=1.0),
+    )
+    with pytest.raises(ValueError, match="greedy"):
+        _paged(params, gen, PagedConfig(spec_draft_tokens=4))
+
+
+# ---------------------------------------------------------------------------
+# residency + gather-freedom acceptance checks
+# ---------------------------------------------------------------------------
+
+
+def test_verify_step_program_contains_no_gather(params):
+    """Acceptance: the multi-token verify jaxpr must not materialize the
+    (b, kv_limit, NKV, D) block-table gather when the kernel is on — and
+    must when it is off (the walker actually detects it)."""
+    b, k, kv_limit, nb, bs, w = 4, 4, 32, 16, 8, 8
+
+    def all_shapes(jaxpr, acc):
+        for eqn in jaxpr.eqns:
+            for v in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(v, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    acc.add(tuple(aval.shape))
+            for p in eqn.params.values():
+                for x in (p if isinstance(p, (list, tuple)) else [p]):
+                    if hasattr(x, "jaxpr"):
+                        all_shapes(x.jaxpr, acc)
+                    elif hasattr(x, "eqns"):
+                        all_shapes(x, acc)
+        return acc
+
+    forbidden = (b, kv_limit, TINY.num_kv_heads, TINY.head_dim)
+    for flag, expect_gather in ((False, True), (True, False)):
+        cfg = dataclasses.replace(TINY, use_paged_kernel=flag)
+        model = LlamaDecode(cfg)
+        cache = model.init_paged_cache(nb, bs)
+        closed = jax.make_jaxpr(
+            lambda p, c, t, ps, tb, dl: model.verify_step(  # noqa: B023
+                p, c, t, ps, tb, dl, kv_limit=kv_limit
+            )
+        )(
+            params, cache, jnp.zeros((b, k + 1), jnp.int32),
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b, w), jnp.int32),
+            jnp.zeros((b,), jnp.int32),
+        )
+        shapes = all_shapes(closed.jaxpr, set())
+        assert (forbidden in shapes) is expect_gather, (
+            f"use_paged_kernel={flag}: gather aval {forbidden} "
+            f"{'missing' if expect_gather else 'present'} in verify jaxpr"
+        )
+
+
+def test_spec_steady_state_residency(params):
+    """Acceptance: the PR 4 zero-upload property holds with speculation
+    enabled — steady-state steps upload nothing except, on verify steps,
+    the draft block itself (drafts + draft_len: exactly 2 uploads), and
+    never re-push tokens/positions/tables."""
+    gen = GenerationConfig(max_new_tokens=24)
+    paged = _paged(
+        params, gen,
+        PagedConfig(
+            block_size=32, num_blocks=8, async_loop=True, spec_draft_tokens=4
+        ),
+    )
+    paged.submit(_rep_prompts(np.random.default_rng(0), (6,))[0])
+    paged.step()  # admission + prefill
+    paged.step()  # first decode dispatch (flushes the dirty lane)
+    m = paged.metrics
+    for _ in range(12):
+        before = (m.h2d_uploads, m.lane_syncs, m.table_deltas, m.verify_steps)
+        if not paged.step():
+            break
+        d_uploads = m.h2d_uploads - before[0]
+        is_verify = m.verify_steps - before[3]
+        assert m.lane_syncs == before[1]
+        assert m.table_deltas == before[2]
+        assert d_uploads == (2 if is_verify else 0), (d_uploads, is_verify)
+    paged.run_to_completion()
+    assert m.verify_steps > 0
+
+
+def test_spec_metrics_in_snapshot(params):
+    gen = GenerationConfig(max_new_tokens=8)
+    paged = _paged(
+        params, gen,
+        PagedConfig(block_size=8, num_blocks=32, spec_draft_tokens=4),
+    )
+    _run(paged, _rep_prompts(np.random.default_rng(4), (9, 13)))
+    snap = paged.metrics.snapshot(paged.allocator, paged.index)
+    for key in (
+        "draft_tokens", "accepted_tokens", "verify_steps",
+        "spec_disabled_lanes", "accept_rate",
+    ):
+        assert key in snap, key
+    assert snap["verify_steps"] > 0
+    assert snap["draft_tokens"] >= snap["accepted_tokens"] > 0
+    assert snap["accept_rate"] == pytest.approx(
+        snap["accepted_tokens"] / snap["draft_tokens"], abs=1e-3
+    )
