@@ -84,6 +84,7 @@ LAYOUT_READERS = frozenset(
         "tensor_parallel_size_or",
         "sequence_parallel_enabled",
         "model_parallel_is_initialized",
+        "mesh_is_tp_only",
     }
 )
 

@@ -111,6 +111,7 @@ class LlamaDecode:
     # the jit cache (parallel/state.py)
     __layout_deps__ = (
         "model_parallel_is_initialized", "get_parallel_state",
+        "get_tensor_model_parallel_size", "mesh_is_tp_only",
     )
 
     def _model(self) -> LlamaForCausalLM:
@@ -412,11 +413,31 @@ class LlamaDecode:
                 # dense path's j <= position + t, per fresh token.
                 from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (
                     paged_flash_decode,
+                    paged_flash_decode_tp,
+                )
+                from neuronx_distributed_llama3_2_tpu.parallel import (
+                    state as parallel_state,
                 )
 
-                att = paged_flash_decode(
-                    q, kc, vc, block_tables, positions, kv_limit=limit,
-                )
+                if (
+                    parallel_state.model_parallel_is_initialized()
+                    and parallel_state.get_parallel_state().mesh.size > 1
+                ):
+                    # multi-chip: the kernel runs per rank in a shard_map
+                    # region on its NKV head slice (eligibility guarantees
+                    # a pure-tp mesh with divisible heads); out spec = the
+                    # q head split, so the constrain below is a no-op
+                    # restatement, and the row-parallel o-projection right
+                    # after attention performs the tp reduction
+                    att = paged_flash_decode_tp(
+                        q, kc, vc, block_tables, positions,
+                        mesh=parallel_state.get_parallel_state().mesh,
+                        kv_limit=limit,
+                    )
+                else:
+                    att = paged_flash_decode(
+                        q, kc, vc, block_tables, positions, kv_limit=limit,
+                    )
                 att = constrain(att, P(BATCH_AXES, None, ha, None))
             else:
                 jlog = jnp.arange(limit, dtype=jnp.int32)
@@ -521,9 +542,17 @@ class LlamaDecode:
         blocks, and suffix-prefill chunks that fit the bound all qualify;
         longer prefill buckets and tree verification keep the dense gather
         (a tree's in-block mask is its ancestor matrix, not the kernel's
-        block-causal ``row <= position + ti``) — and no multi-device mesh
-        (``pallas_call`` is opaque to the SPMD partitioner, so under tp the
-        gather path's sharded einsums stay the right choice)."""
+        block-causal ``row <= position + ti``).
+
+        Multi-device meshes are eligible when the mesh is **pure tensor
+        parallel** and tp divides both head counts: the kernel then runs
+        per rank inside a manual region on its NKV head slice
+        (``paged_flash_decode_tp`` — identical grid, NKV/tp heads per
+        chip, tables/positions replicated, tp-reduce supplied by the
+        row-parallel o-projection). A non-divisible head count (the pool
+        replicates, ``paged_cache_specs``) or a dp/pp/cp/ep-extended mesh
+        (replicated tables no longer cover the whole mesh head-split-only)
+        keeps the sharded dense-gather einsums."""
         from neuronx_distributed_llama3_2_tpu.parallel import (
             state as parallel_state,
         )
@@ -532,8 +561,14 @@ class LlamaDecode:
             return False
         if not 1 <= t <= self.config.paged_kernel_max_t:
             return False
-        if parallel_state.model_parallel_is_initialized():
-            if parallel_state.get_parallel_state().mesh.size > 1:
+        if (
+            parallel_state.model_parallel_is_initialized()
+            and parallel_state.get_parallel_state().mesh.size > 1
+        ):
+            if not parallel_state.mesh_is_tp_only():
+                return False
+            tp = parallel_state.get_tensor_model_parallel_size()
+            if self.config.num_kv_heads % tp or self.config.num_heads % tp:
                 return False
         return True
 

@@ -254,3 +254,78 @@ def paged_flash_decode(
     out = out.reshape(b, nkv, t, g, d).transpose(0, 2, 1, 3, 4)
     out = out.reshape(b, t, n, d).astype(q.dtype)
     return out[:, 0] if squeeze else out
+
+
+def paged_flash_decode_tp(
+    q: jax.Array,             # (b, N, D) single query — or (b, t, N, D)
+    k_pool: jax.Array,        # (num_blocks, bs, NKV, D) pool slice
+    v_pool: jax.Array,        # (num_blocks, bs, NKV, D)
+    block_tables: jax.Array,  # (b, W) int32 — REPLICATED per rank
+    positions: jax.Array,     # (b,) int32 — REPLICATED per rank
+    *,
+    mesh,
+    kv_limit: int | None = None,
+    num_splits: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """:func:`paged_flash_decode` sharded over the tensor-parallel mesh.
+
+    ``pallas_call`` is opaque to the SPMD partitioner, so the kernel cannot
+    live inside an auto-sharded jit region on a multi-chip mesh. This
+    wrapper puts it in a manual (``shard_map``) region instead, split on the
+    **NKV head axis** — the kernel grid is already ``(b, NKV, splits,
+    blocks)``, so each rank runs the *identical* kernel body on its
+    ``NKV/tp`` head slice:
+
+    - q heads shard contiguously over tp (the QKV column-parallel layout):
+      rank r's q heads ``[r·N/tp, (r+1)·N/tp)`` are exactly the G-groups of
+      its kv heads ``[r·NKV/tp, (r+1)·NKV/tp)``, so per-rank GQA grouping
+      (``g = N/NKV``) is unchanged and no head ever crosses a rank.
+    - the K/V pool shards the same way (``LlamaDecode.paged_cache_specs``):
+      the pool *block* dim stays whole per rank, so block tables index
+      identically on every chip — per-chip pool bytes drop by tp, which is
+      the multi-chip capacity win (tp× aggregate lanes/kv_limit at fixed
+      per-chip HBM).
+    - block tables and positions ride in replicated, matching the serving
+      engine's device-resident state: the ``lane_set``/``table_delta``
+      scatters and the zero-upload steady state are layout-independent.
+    - the region contains NO collective: each rank's output is its head
+      slice (out spec = q spec), and the model's row-parallel o-projection
+      immediately after attention performs the tp reduction it already
+      owned — the tp decode step adds zero extra communication.
+
+    Axes the specs don't mention (dp/pp/cp/ep) replicate; eligibility
+    (``_paged_kernel_eligible``) only routes here on a pure-tp mesh where
+    those axes are size 1.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_llama3_2_tpu.parallel.state import TP_AXIS
+
+    n = q.shape[-2]
+    nkv = k_pool.shape[2]
+    tp = mesh.shape[TP_AXIS]
+    if n % tp or nkv % tp:
+        raise ValueError(
+            f"q heads ({n}) and kv heads ({nkv}) must both divide tp ({tp}); "
+            "the caller (_paged_kernel_eligible) should have fallen back"
+        )
+    q_spec = (
+        P(None, TP_AXIS, None) if q.ndim == 3 else P(None, None, TP_AXIS, None)
+    )
+    pool_spec = P(None, None, TP_AXIS, None)
+
+    def local(qs, ks, vs, tbl, pos):
+        return paged_flash_decode(
+            qs, ks, vs, tbl, pos,
+            kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
+        )
+
+    # check_vma off: pallas_call carries no replication rule on either jax
+    # generation; the per-rank outputs are genuinely tp-varying anyway
+    return compat.shard_map(
+        local, mesh,
+        in_specs=(q_spec, pool_spec, pool_spec, P(None, None), P(None)),
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, k_pool, v_pool, block_tables, positions)

@@ -284,6 +284,21 @@ def tensor_parallel_size_or(default: int = 1) -> int:
     )
 
 
+def mesh_is_tp_only() -> bool:
+    """True when the live mesh's only non-trivial axis is tp (dp/pp/cp/ep
+    all size 1) — the layout under which replicated-per-chip serving state
+    (block tables, positions, resident tokens) is exactly replicated and a
+    head-sharded shard_map region covers the whole mesh. The paged decode
+    kernel's multi-chip eligibility rule (``LlamaDecode._paged_kernel_eligible``)
+    keys on this: under a dp/pp-extended mesh the sharded dense-gather
+    einsums remain the right choice. False when parallel state is not
+    initialized (a size-1 "mesh of nothing" is not a tp mesh)."""
+    if _PARALLEL_STATE is None:
+        return False
+    mesh = _PARALLEL_STATE.mesh
+    return mesh.shape[TP_AXIS] == mesh.size
+
+
 def get_pipeline_model_parallel_size() -> int:
     return get_parallel_state().pipeline_parallel_size
 

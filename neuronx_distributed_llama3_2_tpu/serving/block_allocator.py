@@ -193,3 +193,33 @@ class BlockAllocator:
         self.release(bid)
         self.cow_copies += 1
         return new, True
+
+
+def kv_pool_bytes_per_rank(
+    *,
+    num_layers: int,
+    num_blocks: int,
+    block_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype_bytes: int,
+    tp_size: int = 1,
+) -> int:
+    """Bytes of paged KV pool (K and V) resident on ONE chip.
+
+    The pool shards its kv-head dim over the tensor-parallel mesh when
+    divisible (``LlamaDecode.paged_cache_specs`` — the same GQA rule as the
+    dense cache) and replicates otherwise, so per-chip heads are
+    ``num_kv_heads / tp`` or ``num_kv_heads``. ``tp_size=1`` gives the whole
+    logical pool — the capacity statement "tp chips hold a tp×-larger
+    aggregate pool at fixed per-chip HBM" is exactly
+    ``f(tp=1) == tp * f(tp)`` when the heads divide. Pure arithmetic on
+    explicit dims (the allocator knows nothing about the model); the engine
+    feeds it into ``ServingMetrics.pool_bytes_per_rank``.
+    """
+    heads = (
+        num_kv_heads // tp_size
+        if tp_size > 1 and num_kv_heads % tp_size == 0
+        else num_kv_heads
+    )
+    return 2 * num_layers * num_blocks * block_size * heads * head_dim * dtype_bytes

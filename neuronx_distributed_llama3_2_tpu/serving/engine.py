@@ -233,6 +233,26 @@ class PagedServingEngine:
         self.allocator = BlockAllocator(paged.num_blocks, bs)
         self.index = RadixPrefixIndex(self.allocator)
         self.metrics = ServingMetrics()
+        # static pool-layout rows: under a tp mesh the kv-head-sharded pool
+        # (paged_cache_specs) puts only NKV/tp heads on each chip, so the
+        # same per-chip HBM holds a tp×-larger logical pool — the multi-chip
+        # capacity win, made observable in every metrics snapshot
+        from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
+            kv_pool_bytes_per_rank,
+        )
+
+        mc = self.model.config
+        tp = parallel_state.tensor_parallel_size_or(1)
+        pool_dims = dict(
+            num_layers=mc.num_layers, num_blocks=paged.num_blocks,
+            block_size=bs, num_kv_heads=mc.num_kv_heads,
+            head_dim=mc.head_dim, dtype_bytes=self.cache.k.dtype.itemsize,
+        )
+        self.metrics.tp_size = tp
+        self.metrics.pool_bytes_total = kv_pool_bytes_per_rank(**pool_dims)
+        self.metrics.pool_bytes_per_rank = kv_pool_bytes_per_rank(
+            **pool_dims, tp_size=tp
+        )
 
         self._next_rid = 0
         self._queue: List[_PagedRequest] = []

@@ -44,6 +44,13 @@ class ServingMetrics:
     # -- step-phase timing (monotonic clock around dispatch/readback) --
     host_schedule_ms: float = 0.0  # cumulative step time minus device waits
     device_wait_ms: float = 0.0    # cumulative blocking token-readback time
+    # -- tensor-parallel layout (static, set once at engine construction;
+    #    docs/serving.md "Multi-chip serving") --
+    tp_size: int = 1               # tensor-parallel size serving the pool
+    pool_bytes_per_rank: int = 0   # KV pool bytes resident on each chip
+    pool_bytes_total: int = 0      # whole logical pool (== per_rank * tp
+    #                                when the kv heads divide tp; == per_rank
+    #                                on the replication fallback)
     # -- speculative decoding (docs/serving.md "Speculative decoding") --
     draft_tokens: int = 0          # drafts offered to verify steps
     accepted_tokens: int = 0       # drafts the target's argmax agreed with

@@ -24,11 +24,20 @@ Three measurements for the gather-free paged decode path (docs/serving.md):
    greedy-output parity; the speedup column is meaningful only on a real
    chip (CPU has nothing to overlap).
 
+4. **tp=1 vs tp=N A/B** for multi-chip serving: the same workload on the
+   single-chip engine and on a pure-tp mesh (kv-head-sharded pool,
+   shard_map-wrapped kernel), reporting steps/sec for both plus a
+   max-resident-lanes capacity sweep — lanes per ``kv_limit`` bucket at
+   the tp=1 pool's per-chip HBM budget, which the NKV/tp head slice grows
+   ~tp×.  Skipped (recorded, not failed) below ``--tp`` devices.
+
 Gates (record still prints on failure, like kv_block_bench.py):
 
 - per-``kv_limit`` greedy argmax parity, kernel vs gather
 - token-identical greedy outputs, chunked vs unchunked admission
 - token-identical greedy outputs, async vs sync serving loop
+- token-identical greedy outputs, tp=N mesh vs tp=1, with the paged
+  kernel still eligible (no dense-gather fallback) under the mesh
 
 Usage::
 
@@ -68,6 +77,9 @@ def build_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--max-new-tokens", type=int, default=12)
     ap.add_argument("--spec-draft-tokens", type=int, default=4,
                     help="draft width for the speculative on/off A/B")
+    ap.add_argument("--tp", type=int, default=2,
+                    help="mesh size for the tp=1 vs tp=N serving A/B "
+                    "(skipped with a record note when fewer devices exist)")
     ap.add_argument("--max-seq-len", type=int, default=256)
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
@@ -363,6 +375,127 @@ def _spec_ab(config, params, args):
     }
 
 
+def _tp_ab(config, params, args):
+    """tp=1 vs tp=N serving-loop A/B plus the max-resident-lanes capacity
+    sweep (docs/serving.md "Multi-chip serving").
+
+    The same decode workload runs to completion on the single-chip engine
+    and on a pure-tp mesh (kv-head-sharded pool + shard_map-wrapped kernel,
+    replicated tables). Gates: greedy-output parity and kernel eligibility
+    at tp=N (the sharded path must not have fallen back to the gather).
+    Steps/sec is reported, not gated — on CPU the per-rank head slice buys
+    nothing; on a real chip the win is HBM *capacity*, which the sweep
+    states exactly: max resident lanes per kv_limit bucket at the tp=1
+    pool's per-chip byte budget, where per-lane per-rank bytes shrink by
+    tp. Skips (with a record note) when the host has < tp devices or the
+    model's heads don't divide tp."""
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.parallel.state import (
+        destroy_model_parallel,
+        initialize_model_parallel,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
+        kv_pool_bytes_per_rank,
+    )
+
+    tp = args.tp
+    if tp < 2:
+        return {"tp_ab_skipped": "tp < 2"}
+    if len(jax.devices()) < tp:
+        return {
+            "tp_ab_skipped":
+            f"needs {tp} devices, have {len(jax.devices())}"
+        }
+    if config.num_kv_heads % tp or config.num_heads % tp:
+        return {
+            "tp_ab_skipped":
+            f"heads n={config.num_heads}/nkv={config.num_kv_heads} "
+            f"do not divide tp={tp}"
+        }
+
+    cfg = dataclasses.replace(config, use_paged_kernel=True)
+    rng = np.random.default_rng(args.seed)
+    prompts = [
+        rng.integers(0, config.vocab_size, size=(args.short_tokens,)).tolist()
+        for _ in range(args.max_batch)
+    ]
+    gen = GenerationConfig(max_new_tokens=args.max_new_tokens)
+    buckets = [x for x in (8, 16, 32, 64, 128) if x <= args.max_seq_len]
+    num_blocks = 4 * (args.max_seq_len // args.block_size)
+
+    def run():
+        eng = InferenceEngine(
+            cfg, params,
+            max_batch=args.max_batch, max_seq_len=args.max_seq_len,
+            buckets=buckets,
+        )
+        paged = PagedServingEngine(
+            eng, gen,
+            PagedConfig(block_size=args.block_size, num_blocks=num_blocks),
+        )
+        eligible = paged.model._paged_kernel_eligible(1, None)
+        for p in prompts:
+            paged.submit(p)
+        t0 = time.perf_counter()
+        out = paged.run_to_completion()
+        wall = time.perf_counter() - t0
+        snap = paged.metrics.snapshot()
+        return out, paged.metrics.decode_steps / wall, eligible, snap
+
+    out_1, sps_1, _, snap_1 = run()
+    initialize_model_parallel(
+        tensor_model_parallel_size=tp, devices=jax.devices()[:tp]
+    )
+    try:
+        out_n, sps_n, eligible_n, snap_n = run()
+    finally:
+        destroy_model_parallel()
+
+    # capacity sweep: at the tp=1 pool's per-chip byte budget, how many
+    # lanes fit per kv_limit bucket when the per-lane per-rank bytes shrink
+    # to NKV/tp heads (pure pool arithmetic — the steps/sec columns above
+    # are the latency side, this is the HBM side of the multi-chip win)
+    itemsize = np.dtype(cfg.dtype).itemsize  # ml_dtypes registers bf16
+    shared = dict(
+        num_layers=cfg.num_layers, block_size=args.block_size,
+        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+        dtype_bytes=itemsize,
+    )
+    budget = kv_pool_bytes_per_rank(**shared, num_blocks=num_blocks)
+    capacity = []
+    for limit in args.kv_limit_list:
+        nblk = -(-limit // args.block_size)
+        lanes_1 = budget // kv_pool_bytes_per_rank(**shared, num_blocks=nblk)
+        lanes_n = budget // kv_pool_bytes_per_rank(
+            **shared, num_blocks=nblk, tp_size=tp
+        )
+        capacity.append({
+            "kv_limit": limit,
+            "max_lanes_tp1": int(lanes_1),
+            "max_lanes_tpN": int(lanes_n),
+        })
+    return {
+        "tp": tp,
+        "tp1_steps_per_s": round(sps_1, 2),
+        "tpN_steps_per_s": round(sps_n, 2),
+        "tp_parity": out_1 == out_n,
+        "tp_kernel_eligible": bool(eligible_n),
+        "tp_pool_bytes_per_rank": snap_n["pool_bytes_per_rank"],
+        "tp1_pool_bytes_per_rank": snap_1["pool_bytes_per_rank"],
+        "tp_capacity_cases": capacity,
+    }
+
+
 def run_bench(args: argparse.Namespace) -> dict:
     import jax
 
@@ -379,6 +512,7 @@ def run_bench(args: argparse.Namespace) -> dict:
     stall = _stall_ab(config, params, args)
     loop_ab = _async_ab(config, params, args)
     spec = _spec_ab(config, params, args)
+    tp_ab = _tp_ab(config, params, args)
 
     record = {
         "bench": "paged_decode",
@@ -392,6 +526,7 @@ def run_bench(args: argparse.Namespace) -> dict:
         **stall,
         **loop_ab,
         **spec,
+        **tp_ab,
     }
     failures = []
     for c in cases:
@@ -410,6 +545,14 @@ def run_bench(args: argparse.Namespace) -> dict:
             "speculation failed to beat 1 token/step on repetitive prompts "
             f"({spec['spec_tokens_per_step']})"
         )
+    if "tp_ab_skipped" not in tp_ab:
+        if not tp_ab["tp_parity"]:
+            failures.append("tp-sharded serving outputs diverge from tp=1")
+        if not tp_ab["tp_kernel_eligible"]:
+            failures.append(
+                "tp-sharded engine fell back to the dense gather "
+                "(paged kernel not eligible under the mesh)"
+            )
     if failures:
         record["gate_failure"] = "; ".join(failures)
     return record
@@ -417,6 +560,14 @@ def run_bench(args: argparse.Namespace) -> dict:
 
 def main() -> None:
     args = build_args()
+    if args.smoke:
+        # the smoke tier is the CPU CI check; a 2-device virtual backend
+        # lets the tp A/B run there too (must precede backend init)
+        from neuronx_distributed_llama3_2_tpu.utils.compat import (
+            set_cpu_devices,
+        )
+
+        set_cpu_devices(max(2, args.tp))
     record = run_bench(args)
     # the record prints even when a gate fails: a regression must still
     # yield the measured numbers, not just an exception tail

@@ -142,6 +142,78 @@ def _paged_case(name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed, t=1):
     return ok
 
 
+def _sharded_paged_case(
+    name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed, t=1, tp=2
+):
+    """tp-sharded paged decode (shard_map-wrapped kernel) vs the single-chip
+    kernel on the same inputs.
+
+    Exercises the real multi-chip layout of docs/serving.md "Multi-chip
+    serving": q and the K/V pool head-sharded over a pure-tp mesh, block
+    tables + positions replicated, each rank running the identical kernel
+    on its NKV/tp head slice. The reference is the *unsharded* kernel (its
+    own parity vs the dense gather is asserted by the paged-* cases above),
+    so this case isolates exactly the shard_map wrapping. Forward-only,
+    bf16, like serving decode. Skips (ok) below ``tp`` devices.
+    """
+    from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (
+        paged_flash_decode,
+        paged_flash_decode_tp,
+    )
+    from neuronx_distributed_llama3_2_tpu.parallel.state import (
+        destroy_model_parallel,
+        initialize_model_parallel,
+    )
+
+    if len(jax.devices()) < tp:
+        print(f"[skip] {name}: needs {tp} devices, have {len(jax.devices())}")
+        return True
+
+    ks = jax.random.split(jax.random.key(seed), 3)
+    qshape = (b, n, d) if t == 1 else (b, t, n, d)
+    q = (jax.random.normal(ks[0], qshape, jnp.float32) * 0.5).astype(jnp.bfloat16)
+    kp = (jax.random.normal(ks[1], (nb, bs, nkv, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    vp = (jax.random.normal(ks[2], (nb, bs, nkv, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    rng = np.random.default_rng(seed)
+    nblk = -(-kv_limit // bs)
+    perm = rng.permutation(np.arange(1, nb))
+    tables = np.zeros((b, w), np.int32)
+    for i in range(b):
+        tables[i, :nblk] = perm[i * nblk:(i + 1) * nblk]
+    tables = jnp.asarray(tables)
+    positions = jnp.asarray(
+        rng.integers(0, kv_limit - t + 1, size=(b,)), jnp.int32
+    ).at[0].set(kv_limit - t)
+
+    o_ref = jax.jit(
+        lambda q, kp, vp: paged_flash_decode(
+            q, kp, vp, tables, positions,
+            kv_limit=kv_limit, num_splits=num_splits,
+        )
+    )(q, kp, vp)
+    o_ref = np.asarray(o_ref, np.float32)
+    st = initialize_model_parallel(
+        tensor_model_parallel_size=tp, devices=jax.devices()[:tp]
+    )
+    try:
+        o_tp = jax.jit(
+            lambda q, kp, vp: paged_flash_decode_tp(
+                q, kp, vp, tables, positions, mesh=st.mesh,
+                kv_limit=kv_limit, num_splits=num_splits,
+            )
+        )(q, kp, vp)
+        o_tp = np.asarray(o_tp, np.float32)
+    finally:
+        destroy_model_parallel()
+    denom = max(float(np.abs(o_ref).max()), 1e-9)
+    rel = float(np.abs(o_tp - o_ref).max()) / denom
+    # same kernel body on disjoint head slices: only layout/compilation
+    # differences separate the two, so the tolerance is tight
+    ok = rel < 1e-3
+    print(f"[{'ok' if ok else 'FAIL'}] {name}: rel_tp={rel:.2e}")
+    return ok
+
+
 def main() -> int:
     if jax.default_backend() == "cpu":
         print("tpu_kernel_gate: no TPU backend available (CPU only) — skipping")
@@ -169,6 +241,17 @@ def main() -> int:
     ]
     for c in paged_cases:
         ok &= _paged_case(*c)
+    # tp=2 head-sharded shard_map wrapping of the same kernel (serving's
+    # multi-chip layout); nkv/n both divide tp in every case by design
+    #                 name                  b  n  nkv d   nb  bs  w  L    spl sd  t
+    sharded_cases = [
+        ("sharded-paged-decode",    4, 8, 2, 64, 33, 16, 8, 128, 4, 20),
+        ("sharded-paged-verify-t2", 4, 8, 2, 64, 33, 16, 8, 128, 4, 21, 2),
+        ("sharded-paged-verify-t4", 3, 8, 2, 64, 33, 16, 8, 100, 2, 22, 4),
+        ("sharded-paged-verify-t8", 2, 4, 4, 64, 17, 16, 4, 64,  1, 23, 8),
+    ]
+    for c in sharded_cases:
+        ok &= _sharded_paged_case(*c)
     print("tpu_kernel_gate:", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 
