@@ -85,6 +85,7 @@ LAYOUT_READERS = frozenset(
         "sequence_parallel_enabled",
         "model_parallel_is_initialized",
         "mesh_is_tp_only",
+        "kv_head_shard_size",
     }
 )
 

@@ -81,10 +81,21 @@ class PagedKVCache(NamedTuple):
     as the null block: block-table entries past a request's allocated
     frontier point at it, so bucket-padding writes land in garbage rows
     that no masked read ever sees.
+
+    Quantized mode (``PagedConfig.kv_cache_dtype`` int8/fp8): ``k``/``v``
+    hold the low-bit payloads and ``k_scale``/``v_scale`` carry the
+    per-(token row, kv head) absmax scales in block-granular arrays
+    ``(L, num_blocks, block_size, n_kv)`` — a block copy (COW) copies its
+    scale tile, a frontier overwrite replaces payload and scale together
+    (:mod:`..quantization.kv_cache`). ``None`` scales (the default) are the
+    fp pool: the pytree then flattens to exactly the pre-quantization
+    ``(k, v)`` pair, so every fp trace and donation pattern is unchanged.
     """
 
     k: jax.Array
     v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def num_blocks(self) -> int:
@@ -93,6 +104,10 @@ class PagedKVCache(NamedTuple):
     @property
     def block_size(self) -> int:
         return self.k.shape[2]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,23 +149,54 @@ class LlamaDecode:
         return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
     def init_paged_cache(
-        self, num_blocks: int, block_size: int, dtype: Any = None
+        self, num_blocks: int, block_size: int, dtype: Any = None,
+        kv_cache_dtype: Optional[str] = None,
     ) -> PagedKVCache:
         """Block-pool cache for the paged serving path (``serving/``):
         capacity is ``num_blocks * block_size`` token rows shared by every
-        request, instead of ``max_batch * max_seq_len`` dense rows."""
-        c = self.config
-        dtype = dtype or c.dtype
-        shape = (c.num_layers, num_blocks, block_size, c.num_kv_heads, c.head_dim)
-        return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+        request, instead of ``max_batch * max_seq_len`` dense rows.
 
-    def paged_cache_specs(self) -> PagedKVCache:
+        ``kv_cache_dtype`` int8/fp8 allocates the low-bit payload pools plus
+        the per-(row, head) scale arrays (docs/serving.md "Quantized KV
+        pool"); ``None``/"bf16" is the fp pool at ``dtype or config.dtype``
+        with no scales — byte-identical to the pre-quantization cache.
+        """
+        c = self.config
+        shape = (c.num_layers, num_blocks, block_size, c.num_kv_heads, c.head_dim)
+        if kv_cache_dtype in (None, "bf16"):
+            dtype = dtype or c.dtype
+            return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+        from neuronx_distributed_llama3_2_tpu.quantization.kv_cache import (
+            KV_SCALE_DTYPE,
+            kv_cache_jax_dtype,
+        )
+
+        if dtype is not None:
+            raise ValueError(
+                "cache dtype override and quantized kv_cache_dtype are "
+                "mutually exclusive — the storage dtype IS the quantization"
+            )
+        qdt = kv_cache_jax_dtype(kv_cache_dtype)
+        sshape = shape[:-1]
+        return PagedKVCache(
+            k=jnp.zeros(shape, qdt), v=jnp.zeros(shape, qdt),
+            k_scale=jnp.zeros(sshape, KV_SCALE_DTYPE),
+            v_scale=jnp.zeros(sshape, KV_SCALE_DTYPE),
+        )
+
+    def paged_cache_specs(self, quantized: bool = False) -> PagedKVCache:
         """Paged-pool sharding: kv heads over tp (same GQA rule as the dense
         cache); the pool dim is not sharded — any block must be writable by
-        any request regardless of which dp rank admitted it."""
+        any request regardless of which dp rank admitted it. Scale arrays
+        (``quantized=True``) shard their kv-head axis with the *same* rule,
+        so a rank's scale slice always matches its payload slice and dequant
+        needs no collective."""
         ha = _head_axis(self.config.num_kv_heads)
         spec = P(None, None, None, ha, None)
-        return PagedKVCache(k=spec, v=spec)
+        if not quantized:
+            return PagedKVCache(k=spec, v=spec)
+        sspec = P(None, None, None, ha)
+        return PagedKVCache(k=spec, v=spec, k_scale=sspec, v_scale=sspec)
 
     def cache_specs(self, max_batch: Optional[int] = None) -> KVCache:
         """Cache sharding: batch over dp axes, kv heads over tp when
@@ -230,6 +276,17 @@ class LlamaDecode:
             pos_block = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
         else:
             pos_block = positions[:, None] + tree[0][None, :]
+        # quantized paged pool: each layer's cache slice travels as a
+        # (payload, scale) pair through the scan, so _decode_layer and the
+        # per-family overrides stay signature-stable (they only hand the
+        # slices through to _attend_with_cache, which unpacks)
+        quantized = getattr(cache, "k_scale", None) is not None
+        if quantized and block_tables is None:
+            raise ValueError(
+                "quantized KV storage is paged-only — the dense slot cache "
+                "has no scale arrays (use block_tables / PagedServingEngine)"
+            )
+
         if block_tables is None:
             rope_len = cache.max_len
         else:
@@ -251,21 +308,34 @@ class LlamaDecode:
             )
             return x, (kc, vc)
 
+        if quantized:
+            k_stk: Any = (cache.k, cache.k_scale)
+            v_stk: Any = (cache.v, cache.v_scale)
+        else:
+            k_stk, v_stk = cache.k, cache.v
         if c.scan_layers:
             x, (k_new, v_new) = jax.lax.scan(
-                layer_body, x, (params["layers"], cache.k, cache.v)
+                layer_body, x, (params["layers"], k_stk, v_stk)
             )
         else:
             ks, vs = [], []
             for i in range(c.num_layers):
                 lp = jax.tree.map(lambda p: p[i], params["layers"])
-                x, (kc, vc) = layer_body(x, (lp, cache.k[i], cache.v[i]))
+                kc_i = jax.tree.map(lambda a: a[i], k_stk)
+                vc_i = jax.tree.map(lambda a: a[i], v_stk)
+                x, (kc, vc) = layer_body(x, (lp, kc_i, vc_i))
                 ks.append(kc)
                 vs.append(vc)
-            k_new, v_new = jnp.stack(ks), jnp.stack(vs)
+            k_new = jax.tree.map(lambda *a: jnp.stack(a), *ks)
+            v_new = jax.tree.map(lambda *a: jnp.stack(a), *vs)
 
         x = norm(params["final_norm"], x)
-        new_cache = type(cache)(k=k_new, v=v_new)
+        if quantized:
+            new_cache = type(cache)(
+                k=k_new[0], v=v_new[0], k_scale=k_new[1], v_scale=v_new[1]
+            )
+        else:
+            new_cache = type(cache)(k=k_new, v=v_new)
         if return_hidden:
             return x, new_cache
         logits = model._logits(params, x)
@@ -340,6 +410,11 @@ class LlamaDecode:
                 positions, context_encode=context_encode, tree=tree,
                 kv_limit=kv_limit,
             )
+        if isinstance(kc, tuple):
+            raise ValueError(
+                "quantized (payload, scale) cache slices reach the dense "
+                "path only on a caller bug — forward() guards block_tables"
+            )
         kc = kc.at[slots[:, None], write_rows].set(k.astype(kc.dtype))
         vc = vc.at[slots[:, None], write_rows].set(v.astype(vc.dtype))
 
@@ -374,11 +449,21 @@ class LlamaDecode:
         """Paged cache write + attention: the block table translates logical
         sequence rows to pool rows for both the fresh-block scatter and the
         attention gather. kc/vc: (num_blocks, block_size, NKV, D) per-layer
-        pool slice. Numerically identical to the dense path — the gathered
-        K/V rows carry the same values in the same logical order, and
-        garbage rows (stale blocks, null-block padding) are removed by the
-        same ``j <= position + t`` mask."""
+        pool slice — or, quantized, the ((num_blocks, block_size, NKV, D)
+        payload, (num_blocks, block_size, NKV) scale) pair. Numerically
+        identical to the dense path — the gathered K/V rows carry the same
+        values in the same logical order, and garbage rows (stale blocks,
+        null-block padding) are removed by the same ``j <= position + t``
+        mask. Under quantization every attention consumer — the fresh-block
+        prefill softmax included — sees the *round-tripped* (dequantized)
+        K/V, so whole-prompt prefill, chunked re-reads from the pool, the
+        kernel and both gather fallbacks all agree token-for-token."""
         c = self.config
+        quantized = isinstance(kc, tuple)
+        ksc = vsc = None
+        if quantized:
+            kc, ksc = kc
+            vc, vsc = vc
         nb, bs = kc.shape[0], kc.shape[1]
         kflat = kc.reshape((nb * bs,) + kc.shape[2:])
         vflat = vc.reshape((nb * bs,) + vc.shape[2:])
@@ -388,8 +473,31 @@ class LlamaDecode:
             jnp.take_along_axis(block_tables, write_rows // bs, axis=1) * bs
             + write_rows % bs
         )
-        kflat = kflat.at[wr_phys].set(k.astype(kflat.dtype))
-        vflat = vflat.at[wr_phys].set(v.astype(vflat.dtype))
+        if quantized:
+            from neuronx_distributed_llama3_2_tpu.quantization.kv_cache import (
+                kv_dequantize,
+                kv_quantize,
+            )
+
+            # quantize-on-write: payload + per-(row, head) scale land in the
+            # same scatter, so frontier overwrites (speculative rollback)
+            # replace both and stale rows can never poison a later read
+            kq, ks = kv_quantize(k, kflat.dtype)   # (b,t,NKV,D) / (b,t,NKV)
+            vq, vs = kv_quantize(v, vflat.dtype)
+            ksflat = ksc.reshape((nb * bs,) + ksc.shape[2:])
+            vsflat = vsc.reshape((nb * bs,) + vsc.shape[2:])
+            kflat = kflat.at[wr_phys].set(kq)
+            vflat = vflat.at[wr_phys].set(vq)
+            ksflat = ksflat.at[wr_phys].set(ks)
+            vsflat = vsflat.at[wr_phys].set(vs)
+            ksc, vsc = ksflat.reshape(ksc.shape), vsflat.reshape(vsc.shape)
+            # the fresh block the prefill softmax consumes is the same
+            # round-trip a later chunk will read back from the pool
+            k = kv_dequantize(kq, ks, q.dtype)
+            v = kv_dequantize(vq, vs, q.dtype)
+        else:
+            kflat = kflat.at[wr_phys].set(k.astype(kflat.dtype))
+            vflat = vflat.at[wr_phys].set(v.astype(vflat.dtype))
         kc, vc = kflat.reshape(kc.shape), vflat.reshape(vc.shape)
 
         ha = _head_axis(c.num_heads)
@@ -428,26 +536,44 @@ class LlamaDecode:
                     # a pure-tp mesh with divisible heads); out spec = the
                     # q head split, so the constrain below is a no-op
                     # restatement, and the row-parallel o-projection right
-                    # after attention performs the tp reduction
+                    # after attention performs the tp reduction. Scale
+                    # arrays ride in on the same head split — no new
+                    # collective.
                     att = paged_flash_decode_tp(
                         q, kc, vc, block_tables, positions,
                         mesh=parallel_state.get_parallel_state().mesh,
-                        kv_limit=limit,
+                        kv_limit=limit, k_scale=ksc, v_scale=vsc,
                     )
                 else:
                     att = paged_flash_decode(
                         q, kc, vc, block_tables, positions, kv_limit=limit,
+                        k_scale=ksc, v_scale=vsc,
                     )
                 att = constrain(att, P(BATCH_AXES, None, ha, None))
             else:
                 jlog = jnp.arange(limit, dtype=jnp.int32)
                 rd_phys = block_tables[:, jlog // bs] * bs + (jlog % bs)[None, :]
-                k_all = kflat[rd_phys].astype(q.dtype)  # (b, limit, NKV, D)
-                v_all = vflat[rd_phys].astype(q.dtype)
+                if quantized:
+                    # dequant outside the kernel, same f32-widen formula the
+                    # kernel fuses after its block DMA — bit-identical
+                    # operands on every eligibility path
+                    from neuronx_distributed_llama3_2_tpu.quantization.kv_cache import (  # noqa: E501
+                        kv_dequantize,
+                    )
+
+                    k_all = kv_dequantize(
+                        kflat[rd_phys], ksflat[rd_phys], q.dtype
+                    )  # (b, limit, NKV, D)
+                    v_all = kv_dequantize(vflat[rd_phys], vsflat[rd_phys], q.dtype)
+                else:
+                    k_all = kflat[rd_phys].astype(q.dtype)  # (b, limit, NKV, D)
+                    v_all = vflat[rd_phys].astype(q.dtype)
                 att = self._cache_attention(
                     q, k_all, v_all, pos_block, ha, positions=positions,
                     tree=tree,
                 )
+        if quantized:
+            return att, (kc, ksc), (vc, vsc)
         return att, kc, vc
 
     def decode_step(

@@ -70,12 +70,21 @@ def _decode_kernel(
     q_ref,     # (t*G, D) — this lane/kv-head's t fresh query groups
     k_ref,     # (bs, D) — one pool block, fetched through the table
     v_ref,     # (bs, D)
-    o_ref,     # (t*G, D) f32 — per-split UNNORMALIZED accumulator
-    m_ref,     # (t*G, 1) f32 — per-split running max
-    l_ref,     # (t*G, 1) f32 — per-split denominator
-    m_scr, l_scr, acc_scr,
-    *, bs: int, bps: int, nblk: int, t: int, g: int, sm_scale: float,
+    *refs,     # [ks_ref, vs_ref (bs, 1) — quantized scale tiles,] then
+    #            o_ref (t*G, D) f32 per-split UNNORMALIZED accumulator,
+    #            m_ref / l_ref (t*G, 1) f32 per-split running max / denom,
+    #            and the m/l/acc VMEM scratch
+    bs: int, bps: int, nblk: int, t: int, g: int, sm_scale: float,
+    quantized: bool = False,
 ):
+    if quantized:
+        # int8/fp8 pool: the block DMA moved low-bit payload + the block's
+        # (bs, 1) scale column for this kv head; dequant here in VMEM with
+        # the same f32-widen formula as quantization.kv_cache.kv_dequantize
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
     i = pl.program_id(0)          # lane
     s = pl.program_id(2)          # kv split
     j = pl.program_id(3)          # block within split
@@ -96,7 +105,12 @@ def _decode_kernel(
     @pl.when(run)
     def _compute():
         q = q_ref[:]                               # (t*G, D)
-        k = k_ref[:].astype(q.dtype)               # (bs, D)
+        if ks_ref is not None:
+            k = (
+                k_ref[:].astype(jnp.float32) * ks_ref[:].astype(jnp.float32)
+            ).astype(q.dtype)                      # (bs, D)
+        else:
+            k = k_ref[:].astype(q.dtype)           # (bs, D)
         sc = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -119,7 +133,12 @@ def _decode_kernel(
         p = jnp.exp(sc - m_new[:, None])
         p = jnp.where(mask, p, 0.0)
         l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
-        v = v_ref[:].astype(q.dtype)               # (bs, D)
+        if vs_ref is not None:
+            v = (
+                v_ref[:].astype(jnp.float32) * vs_ref[:].astype(jnp.float32)
+            ).astype(q.dtype)                      # (bs, D)
+        else:
+            v = v_ref[:].astype(q.dtype)           # (bs, D)
         pv = lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -146,6 +165,8 @@ def paged_flash_decode(
     kv_limit: int | None = None,
     num_splits: int | None = None,
     interpret: bool | None = None,
+    k_scale: jax.Array | None = None,  # (num_blocks, bs, NKV) — quantized pool
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Gather-free paged decode attention; returns q's shape in q.dtype.
 
@@ -160,6 +181,12 @@ def paged_flash_decode(
     rows visited, exactly like the dense path. The caller guarantees every
     *used* query row sits below ``kv_limit``; extra query rows (bucket
     padding, rejected draft tail) produce garbage the caller discards.
+
+    ``k_scale``/``v_scale`` mark a quantized pool (int8/fp8 payload with
+    per-(row, head) absmax scales, docs/serving.md "Quantized KV pool"):
+    the scale columns ride through the *same* table-dereferencing index map
+    as the payload blocks — one extra tiny (bs, 1) DMA per block — and the
+    kernel dequantizes in VMEM, so HBM traffic stays low-bit.
     """
     squeeze = q.ndim == 3
     if squeeze:
@@ -199,18 +226,37 @@ def paged_flash_decode(
         return (i, h, s, 0, 0)
 
     tg = t * g
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    quantized = k_scale is not None
     kernel = functools.partial(
         _decode_kernel, bs=bs, bps=bps, nblk=nblk, t=t, g=g,
-        sm_scale=sm_scale,
+        sm_scale=sm_scale, quantized=quantized,
     )
+    in_specs = [
+        pl.BlockSpec((None, None, tg, d), q_idx),
+        pl.BlockSpec((None, bs, None, d), kv_idx),
+        pl.BlockSpec((None, bs, None, d), kv_idx),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quantized:
+        if k_scale.shape != (nb, bs, nkv) or v_scale.shape != (nb, bs, nkv):
+            raise ValueError(
+                f"scale arrays must be (num_blocks, bs, NKV) = "
+                f"{(nb, bs, nkv)}, got {k_scale.shape} / {v_scale.shape}"
+            )
+        # trailing singleton keeps the (bs, 1) scale tile 2-D; kv_idx's
+        # 4-tuple (table-deref, 0, head, 0) then serves payload and scale
+        # alike, so the scale column arrives with its block's DMA
+        in_specs += [
+            pl.BlockSpec((None, bs, None, 1), kv_idx),
+            pl.BlockSpec((None, bs, None, 1), kv_idx),
+        ]
+        operands += [k_scale[..., None], v_scale[..., None]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, None, tg, d), q_idx),
-            pl.BlockSpec((None, bs, None, d), kv_idx),
-            pl.BlockSpec((None, bs, None, d), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, None, None, tg, d), out_idx),
             # trailing singleton keeps the last-two-dims tiling legal
@@ -239,7 +285,7 @@ def paged_flash_decode(
         interpret=_interpret() if interpret is None else interpret,
     )(
         block_tables.astype(jnp.int32), positions.astype(jnp.int32),
-        qg, k_pool, v_pool,
+        *operands,
     )
 
     # flash-decoding combine: merge the per-split partial softmaxes by
@@ -267,6 +313,8 @@ def paged_flash_decode_tp(
     kv_limit: int | None = None,
     num_splits: int | None = None,
     interpret: bool | None = None,
+    k_scale: jax.Array | None = None,  # (num_blocks, bs, NKV) — quantized pool
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """:func:`paged_flash_decode` sharded over the tensor-parallel mesh.
 
@@ -315,17 +363,39 @@ def paged_flash_decode_tp(
     )
     pool_spec = P(None, None, TP_AXIS, None)
 
-    def local(qs, ks, vs, tbl, pos):
+    # check_vma off: pallas_call carries no replication rule on either jax
+    # generation; the per-rank outputs are genuinely tp-varying anyway
+    if k_scale is None:
+        def local(qs, ks, vs, tbl, pos):
+            return paged_flash_decode(
+                qs, ks, vs, tbl, pos,
+                kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
+            )
+
+        return compat.shard_map(
+            local, mesh,
+            in_specs=(q_spec, pool_spec, pool_spec, P(None, None), P(None)),
+            out_specs=q_spec,
+            check_vma=False,
+        )(q, k_pool, v_pool, block_tables, positions)
+
+    # quantized pool: the (num_blocks, bs, NKV) scale arrays split the SAME
+    # kv-head axis as the payload pools, so each rank dequantizes its own
+    # head slice locally — still zero in-region collectives
+    scale_spec = P(None, None, TP_AXIS)
+
+    def local_q(qs, ks, vs, kss, vss, tbl, pos):
         return paged_flash_decode(
-            qs, ks, vs, tbl, pos,
+            qs, ks, vs, tbl, pos, k_scale=kss, v_scale=vss,
             kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
         )
 
-    # check_vma off: pallas_call carries no replication rule on either jax
-    # generation; the per-rank outputs are genuinely tp-varying anyway
     return compat.shard_map(
-        local, mesh,
-        in_specs=(q_spec, pool_spec, pool_spec, P(None, None), P(None)),
+        local_q, mesh,
+        in_specs=(
+            q_spec, pool_spec, pool_spec, scale_spec, scale_spec,
+            P(None, None), P(None),
+        ),
         out_specs=q_spec,
         check_vma=False,
-    )(q, k_pool, v_pool, block_tables, positions)
+    )(q, k_pool, v_pool, k_scale, v_scale, block_tables, positions)

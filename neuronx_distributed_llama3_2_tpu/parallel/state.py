@@ -299,6 +299,22 @@ def mesh_is_tp_only() -> bool:
     return mesh.shape[TP_AXIS] == mesh.size
 
 
+def kv_head_shard_size(num_kv_heads: int) -> int:
+    """Per-rank kv-head count under the GQA head-split rule: ``NKV / tp``
+    when tp divides, ``NKV`` on the replication fallback (the same rule
+    ``models.llama._head_axis`` applies when it emits the cache specs, and
+    the head count the per-chip KV-pool byte math must use — both the
+    payload pools and the quantized pool's ``(num_blocks, block_size, NKV)``
+    scale arrays shard this axis, so one reader serves both). Uninitialized
+    parallel state means an unsharded pool (``tensor_parallel_size_or``).
+
+    Layout reader: listed in ``analysis/shardlint.py`` ``LAYOUT_READERS`` —
+    an eq-keyed dataclass calling this must declare ``__layout_deps__``.
+    """
+    tp = tensor_parallel_size_or(1)
+    return num_kv_heads // tp if num_kv_heads % tp == 0 else num_kv_heads
+
+
 def get_pipeline_model_parallel_size() -> int:
     return get_parallel_state().pipeline_parallel_size
 

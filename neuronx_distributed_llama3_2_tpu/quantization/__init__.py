@@ -1,3 +1,11 @@
+from neuronx_distributed_llama3_2_tpu.quantization.kv_cache import (
+    KV_CACHE_DTYPES,
+    KV_SCALE_DTYPE,
+    kv_cache_jax_dtype,
+    kv_dequantize,
+    kv_quantize,
+    kv_scale_itemsize,
+)
 from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
     DEFAULT_TARGETS,
     QuantizationConfig,
@@ -20,6 +28,12 @@ from neuronx_distributed_llama3_2_tpu.quantization.layers import (
 __all__ = [
     "DEFAULT_QUANT_MODULE_MAPPINGS",
     "DEFAULT_TARGETS",
+    "KV_CACHE_DTYPES",
+    "KV_SCALE_DTYPE",
+    "kv_cache_jax_dtype",
+    "kv_dequantize",
+    "kv_quantize",
+    "kv_scale_itemsize",
     "QuantizationConfig",
     "QuantizationType",
     "QuantizedTensor",

@@ -1,0 +1,104 @@
+"""Shared scale math for the quantized paged KV pool.
+
+The weight-only module (:mod:`.quantize`) stores low-bit payloads next to
+absmax scales and dequantizes with a multiply that XLA fuses into the
+consuming matmul — int8/fp8 bytes in HBM, bf16 on the MXU. This module is
+the same idiom applied to the *KV block pool* (``PagedConfig.kv_cache_dtype``,
+docs/serving.md "Quantized KV pool"): decode is cache-bandwidth-bound, so
+halving pool bytes halves both the HBM ceiling on resident lanes and the
+per-step DMA traffic through the paged flash-decode kernel.
+
+Scale semantics follow :func:`.quantize.quantize_array` (symmetric absmax,
+``scale = absmax / qmax``, int8 rounds, fp8 casts), specialized for the
+append-only pool:
+
+- One scale per **written token row per kv head** (absmax over ``head_dim``),
+  stored in block-granular arrays ``(num_blocks, block_size, NKV)`` riding
+  next to the ``(num_blocks, block_size, NKV, D)`` payload pools. A block
+  copy (COW) copies its scale tile; a frontier overwrite (speculative
+  rollback) overwrites payload and scale together.
+- Per-*row* rather than per-*whole-block* absmax is deliberate: the pool is
+  append-only at token granularity, so a block-shared scale would have to be
+  recomputed every time a row lands in a partially-filled block —
+  re-quantizing the sibling rows makes the stored values depend on append
+  order (chunked vs whole prefill would diverge) and lets rolled-back draft
+  rows permanently inflate a block's scale. Row scales are append-local and
+  deterministic, which is what keeps the engine parity matrix *token-exact*
+  across every eligibility path.
+- Scales are stored ``float16`` (:data:`KV_SCALE_DTYPE`): 2 bytes per
+  (row, head) against ``head_dim`` payload bytes keeps int8 capacity at
+  ~1.94x bf16 on Llama-class geometry (D=64), where an f32 scale would eat
+  the margin. Quantization divides by the *stored* (rounded) scale so the
+  write and every later read agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_llama3_2_tpu.quantization.quantize import (
+    QUANTIZED_DTYPES,
+    _qmax,
+)
+
+#: accepted ``PagedConfig.kv_cache_dtype`` values. "bf16" is the fp
+#: passthrough — the pool stays at the model/cache dtype with no scale
+#: arrays and a byte-identical trace to the pre-quantization engine.
+KV_CACHE_DTYPES = {"bf16": jnp.bfloat16, **QUANTIZED_DTYPES}
+
+#: storage dtype of the per-(row, head) scale arrays.
+KV_SCALE_DTYPE = jnp.float16
+
+# scale clamp: the lower bound keeps all-zero rows finite (and is an fp16
+# *normal*, so the stored scale never flushes to 0), the upper bound keeps
+# absmax outliers below fp16 inf. Both only bind on degenerate inputs.
+KV_SCALE_MIN = 1e-6
+KV_SCALE_MAX = 3.0e4
+
+
+def kv_cache_jax_dtype(name: str):
+    """Storage dtype for a ``kv_cache_dtype`` knob value (loud on typos)."""
+    if name not in KV_CACHE_DTYPES:
+        raise ValueError(
+            f"kv_cache_dtype must be one of {sorted(KV_CACHE_DTYPES)}, "
+            f"got {name!r}"
+        )
+    return KV_CACHE_DTYPES[name]
+
+
+def kv_scale_itemsize(name: str) -> int:
+    """Scale bytes per (token row, kv head): 0 for the fp pool."""
+    kv_cache_jax_dtype(name)
+    return 0 if name == "bf16" else jnp.dtype(KV_SCALE_DTYPE).itemsize
+
+
+def kv_quantize(x: jax.Array, qdtype) -> tuple:
+    """Quantize fresh K/V rows ``(..., D)`` to ``(payload, scale)``.
+
+    Scale is absmax over the trailing head_dim, per leading index (the
+    (batch, token, kv-head) lattice of an append), clamped and rounded to
+    :data:`KV_SCALE_DTYPE` *before* the divide so the stored pair
+    round-trips exactly through :func:`kv_dequantize`.
+    """
+    qmax = _qmax(qdtype)
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.clip(absmax / qmax, KV_SCALE_MIN, KV_SCALE_MAX)
+    scale = scale.astype(KV_SCALE_DTYPE)
+    q = xf / scale.astype(jnp.float32)[..., None]
+    if qdtype == jnp.int8:
+        q = jnp.clip(jnp.round(q), -qmax, qmax)
+    else:
+        q = jnp.clip(q, -qmax, qmax)
+    return q.astype(qdtype), scale
+
+
+def kv_dequantize(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """``payload (..., D) * scale (...)`` → ``dtype``. The float32 widen +
+    multiply + cast is the exact formula the Pallas kernel applies in VMEM
+    after the block DMA, so the gather fallbacks and the kernel see
+    bit-identical dequantized operands."""
+    return (
+        q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+    ).astype(dtype)

@@ -204,6 +204,7 @@ def kv_pool_bytes_per_rank(
     head_dim: int,
     dtype_bytes: int,
     tp_size: int = 1,
+    scale_bytes: int = 0,
 ) -> int:
     """Bytes of paged KV pool (K and V) resident on ONE chip.
 
@@ -216,10 +217,17 @@ def kv_pool_bytes_per_rank(
     ``f(tp=1) == tp * f(tp)`` when the heads divide. Pure arithmetic on
     explicit dims (the allocator knows nothing about the model); the engine
     feeds it into ``ServingMetrics.pool_bytes_per_rank``.
+
+    ``dtype_bytes`` is the *storage* itemsize — 1 under an int8/fp8
+    ``PagedConfig.kv_cache_dtype``, where ``scale_bytes`` adds the
+    per-(token row, kv head) scale-array overhead (2 for the fp16 scales of
+    ``quantization.kv_cache``, 0 for the fp pool). The scale arrays shard
+    the same kv-head axis, so the per-rank head count covers both terms.
     """
     heads = (
         num_kv_heads // tp_size
         if tp_size > 1 and num_kv_heads % tp_size == 0
         else num_kv_heads
     )
-    return 2 * num_layers * num_blocks * block_size * heads * head_dim * dtype_bytes
+    row_bytes = head_dim * dtype_bytes + scale_bytes
+    return 2 * num_layers * num_blocks * block_size * heads * row_bytes

@@ -83,6 +83,13 @@ class PagedConfig:
     decode_reserve_blocks: int = 2
     enable_prefix_caching: bool = True
     cache_dtype: Any = None
+    # quantized KV pool (docs/serving.md "Quantized KV pool"): store the
+    # block pool int8/fp8 with per-(row, kv-head) absmax scales and dequant
+    # on read (in-kernel after the block DMA on the Pallas path, outside the
+    # kernel on the gather fallbacks) — ~2x resident lanes or kv_limit per
+    # chip at fixed pool bytes. "bf16" = fp passthrough: pool at the model
+    # (or cache_dtype) precision, no scale arrays, trace unchanged.
+    kv_cache_dtype: str = "bf16"
     metrics_log_every: int = 0  # decode steps between metric log lines; 0=off
     # chunked prefill (Sarathi-Serve): split an admission whose uncached
     # suffix exceeds this many tokens into fixed-budget chunks, one per
@@ -217,8 +224,21 @@ class PagedServingEngine:
                 f"overflow region ({self.table_width * bs - engine.max_seq_len} "
                 f"rows past max_seq_len)"
             )
+        from neuronx_distributed_llama3_2_tpu.quantization.kv_cache import (
+            kv_cache_jax_dtype,
+            kv_scale_itemsize,
+        )
+
+        kv_cache_jax_dtype(paged.kv_cache_dtype)  # validate the knob early
+        self._kv_quantized = paged.kv_cache_dtype != "bf16"
+        if self._kv_quantized and paged.cache_dtype is not None:
+            raise ValueError(
+                "cache_dtype and a quantized kv_cache_dtype are mutually "
+                "exclusive — the quantized storage dtype IS the pool dtype"
+            )
         self.cache = self.model.init_paged_cache(
-            paged.num_blocks, bs, paged.cache_dtype
+            paged.num_blocks, bs, paged.cache_dtype,
+            kv_cache_dtype=paged.kv_cache_dtype,
         )
         from neuronx_distributed_llama3_2_tpu.parallel import (
             state as parallel_state,
@@ -229,7 +249,10 @@ class PagedServingEngine:
                 shard_pytree,
             )
 
-            self.cache = shard_pytree(self.cache, self.model.paged_cache_specs())
+            self.cache = shard_pytree(
+                self.cache,
+                self.model.paged_cache_specs(quantized=self._kv_quantized),
+            )
         self.allocator = BlockAllocator(paged.num_blocks, bs)
         self.index = RadixPrefixIndex(self.allocator)
         self.metrics = ServingMetrics()
@@ -247,8 +270,10 @@ class PagedServingEngine:
             num_layers=mc.num_layers, num_blocks=paged.num_blocks,
             block_size=bs, num_kv_heads=mc.num_kv_heads,
             head_dim=mc.head_dim, dtype_bytes=self.cache.k.dtype.itemsize,
+            scale_bytes=kv_scale_itemsize(paged.kv_cache_dtype),
         )
         self.metrics.tp_size = tp
+        self.metrics.kv_dtype = paged.kv_cache_dtype
         self.metrics.pool_bytes_total = kv_pool_bytes_per_rank(**pool_dims)
         self.metrics.pool_bytes_per_rank = kv_pool_bytes_per_rank(
             **pool_dims, tp_size=tp
@@ -295,13 +320,26 @@ class PagedServingEngine:
         self._wait_ms = 0.0          # per-step readback wait scratch
         self._last_log_step = 0      # dedupe periodic metrics logging
         self._programs: Dict[tuple, Any] = {}
-        self._copy_block_fn = jax.jit(
-            lambda c, s, d: type(c)(
-                k=c.k.at[:, d].set(c.k[:, s]),
-                v=c.v.at[:, d].set(c.v[:, s]),
-            ),
-            donate_argnums=(0,),
-        )
+        if self._kv_quantized:
+            # COW copies the block's scale tile with its payload — the scale
+            # IS part of the block's value under quantized storage
+            self._copy_block_fn = jax.jit(
+                lambda c, s, d: type(c)(
+                    k=c.k.at[:, d].set(c.k[:, s]),
+                    v=c.v.at[:, d].set(c.v[:, s]),
+                    k_scale=c.k_scale.at[:, d].set(c.k_scale[:, s]),
+                    v_scale=c.v_scale.at[:, d].set(c.v_scale[:, s]),
+                ),
+                donate_argnums=(0,),
+            )
+        else:
+            self._copy_block_fn = jax.jit(
+                lambda c, s, d: type(c)(
+                    k=c.k.at[:, d].set(c.k[:, s]),
+                    v=c.v.at[:, d].set(c.v[:, s]),
+                ),
+                donate_argnums=(0,),
+            )
         if precompile:
             self._warmup()
 

@@ -47,6 +47,10 @@ class ServingMetrics:
     # -- tensor-parallel layout (static, set once at engine construction;
     #    docs/serving.md "Multi-chip serving") --
     tp_size: int = 1               # tensor-parallel size serving the pool
+    kv_dtype: str = "bf16"         # PagedConfig.kv_cache_dtype serving the
+    #                                pool ("bf16" = fp passthrough); pool
+    #                                bytes below include the scale arrays
+    #                                when quantized
     pool_bytes_per_rank: int = 0   # KV pool bytes resident on each chip
     pool_bytes_total: int = 0      # whole logical pool (== per_rank * tp
     #                                when the kv heads divide tp; == per_rank

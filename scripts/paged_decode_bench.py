@@ -31,6 +31,14 @@ Three measurements for the gather-free paged decode path (docs/serving.md):
    the tp=1 pool's per-chip HBM budget, which the NKV/tp head slice grows
    ~tp×.  Skipped (recorded, not failed) below ``--tp`` devices.
 
+5. **Quantized-pool A/B** for ``PagedConfig.kv_cache_dtype``: steps/sec
+   with the pool at bf16 vs int8 (+per-row fp16 scales) plus a
+   max-resident-lanes capacity sweep at fixed per-chip bytes and
+   llama-class geometry (head_dim 64).  Gates: the int8 kernel engine is
+   token-identical to the int8 gather engine, and the sweep shows int8
+   fitting ≥1.9× the bf16 lanes; steps/sec and the int8-vs-fp token
+   agreement are reported, not gated.
+
 Gates (record still prints on failure, like kv_block_bench.py):
 
 - per-``kv_limit`` greedy argmax parity, kernel vs gather
@@ -496,6 +504,122 @@ def _tp_ab(config, params, args):
     }
 
 
+def _quant_ab(config, params, args):
+    """Quantized KV pool on/off A/B (docs/serving.md "Quantized KV pool").
+
+    Steps/sec for the same decode workload with ``kv_cache_dtype`` bf16 vs
+    int8, both on the paged kernel. Two gates:
+
+    - **parity**: the int8 kernel engine must be token-identical to the
+      int8 *gather* engine — the documented cross-path exactness of the
+      append-local scales (int8 vs bf16 only carries a tolerance band, so
+      the quantized gather is the right reference, not the fp run).
+    - **capacity**: at a fixed per-chip byte budget and llama-class
+      geometry (head_dim 64), the max-resident-lanes sweep must show int8
+      (+fp16 scales) fitting >= 1.9x the bf16 lanes per kv_limit bucket —
+      the HBM side of the quantization win; steps/sec is reported, not
+      gated (on CPU the int8 round-trip adds work; the bandwidth win needs
+      a real chip).
+    """
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.parallel.state import (
+        kv_head_shard_size,
+    )
+    from neuronx_distributed_llama3_2_tpu.quantization import (
+        kv_scale_itemsize,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
+        kv_pool_bytes_per_rank,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    prompts = [
+        rng.integers(0, config.vocab_size, size=(args.short_tokens,)).tolist()
+        for _ in range(args.max_batch)
+    ]
+    gen = GenerationConfig(max_new_tokens=args.max_new_tokens)
+    buckets = [x for x in (8, 16, 32, 64, 128) if x <= args.max_seq_len]
+    num_blocks = 4 * (args.max_seq_len // args.block_size)
+
+    def run(kv_dtype, kernel=True):
+        cfg = dataclasses.replace(config, use_paged_kernel=kernel)
+        eng = InferenceEngine(
+            cfg, params,
+            max_batch=args.max_batch, max_seq_len=args.max_seq_len,
+            buckets=buckets,
+        )
+        paged = PagedServingEngine(
+            eng, gen,
+            PagedConfig(
+                block_size=args.block_size, num_blocks=num_blocks,
+                kv_cache_dtype=kv_dtype,
+            ),
+        )
+        for p in prompts:
+            paged.submit(p)
+        t0 = time.perf_counter()
+        out = paged.run_to_completion()
+        wall = time.perf_counter() - t0
+        return out, paged.metrics.decode_steps / wall, paged.metrics.snapshot()
+
+    out_fp, sps_fp, snap_fp = run("bf16")
+    out_q, sps_q, snap_q = run("int8")
+    out_qg, _, _ = run("int8", kernel=False)
+
+    # capacity sweep at llama-class geometry (head_dim 64 — the regime the
+    # >= 1.9x acceptance targets; tiny's head_dim 8 would understate the
+    # ratio since the 2-byte scale amortizes over the row). Pure pool
+    # arithmetic at a fixed per-chip byte budget; per-rank kv heads go
+    # through the kv_head_shard_size layout reader so a surrounding mesh
+    # (none in this bench) would be reflected.
+    geom = dict(
+        num_layers=32, block_size=args.block_size,
+        num_kv_heads=kv_head_shard_size(8), head_dim=64,
+    )
+    budget = kv_pool_bytes_per_rank(
+        **geom, num_blocks=1024, dtype_bytes=2
+    )
+    capacity = []
+    for limit in args.kv_limit_list:
+        nblk = -(-limit // args.block_size)
+        lanes_fp = budget // kv_pool_bytes_per_rank(
+            **geom, num_blocks=nblk, dtype_bytes=2
+        )
+        lanes_q = budget // kv_pool_bytes_per_rank(
+            **geom, num_blocks=nblk, dtype_bytes=1,
+            scale_bytes=kv_scale_itemsize("int8"),
+        )
+        capacity.append({
+            "kv_limit": limit,
+            "max_lanes_bf16": int(lanes_fp),
+            "max_lanes_int8": int(lanes_q),
+            "lanes_ratio": round(lanes_q / max(lanes_fp, 1), 3),
+        })
+    return {
+        "quant_bf16_steps_per_s": round(sps_fp, 2),
+        "quant_int8_steps_per_s": round(sps_q, 2),
+        "quant_parity": out_q == out_qg,
+        "quant_token_agreement_vs_fp": round(
+            sum(
+                sum(a == b for a, b in zip(out_fp[r], out_q[r]))
+                / max(len(out_fp[r]), 1)
+                for r in out_fp
+            ) / max(len(out_fp), 1), 3),
+        "quant_pool_bytes_per_rank": snap_q["pool_bytes_per_rank"],
+        "fp_pool_bytes_per_rank": snap_fp["pool_bytes_per_rank"],
+        "quant_capacity_cases": capacity,
+    }
+
+
 def run_bench(args: argparse.Namespace) -> dict:
     import jax
 
@@ -513,6 +637,7 @@ def run_bench(args: argparse.Namespace) -> dict:
     loop_ab = _async_ab(config, params, args)
     spec = _spec_ab(config, params, args)
     tp_ab = _tp_ab(config, params, args)
+    quant = _quant_ab(config, params, args)
 
     record = {
         "bench": "paged_decode",
@@ -527,6 +652,7 @@ def run_bench(args: argparse.Namespace) -> dict:
         **loop_ab,
         **spec,
         **tp_ab,
+        **quant,
     }
     failures = []
     for c in cases:
@@ -553,6 +679,18 @@ def run_bench(args: argparse.Namespace) -> dict:
                 "tp-sharded engine fell back to the dense gather "
                 "(paged kernel not eligible under the mesh)"
             )
+    if not quant["quant_parity"]:
+        failures.append(
+            "int8 kernel outputs diverge from the int8 gather engine"
+        )
+    bad_ratio = [
+        c for c in quant["quant_capacity_cases"] if c["lanes_ratio"] < 1.9
+    ]
+    if bad_ratio:
+        failures.append(
+            "int8 capacity ratio below 1.9x at kv_limit "
+            + ",".join(str(c["kv_limit"]) for c in bad_ratio)
+        )
     if failures:
         record["gate_failure"] = "; ".join(failures)
     return record

@@ -142,6 +142,88 @@ def _paged_case(name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed, t=1):
     return ok
 
 
+def _quant_paged_case(
+    name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed, t=1,
+    kv_dtype="int8",
+):
+    """Quantized paged decode: kernel-side dequant (scales DMAd with the
+    block) vs the gather reference dequantizing OUTSIDE the kernel.
+
+    The pool is stored at ``kv_dtype`` with per-(row, kv-head) fp16 absmax
+    scales (``quantization.kv_cache``); both paths read the identical
+    round-tripped values, so the comparison isolates the in-kernel dequant
+    arithmetic. Tolerance is looser than the fp paged cases: the kernel
+    widens the dequantized product in bf16-adjacent Mosaic arithmetic while
+    the reference stays in fp32 end-to-end.
+    """
+    from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (
+        paged_flash_decode,
+    )
+    from neuronx_distributed_llama3_2_tpu.quantization import (
+        kv_cache_jax_dtype,
+        kv_dequantize,
+        kv_quantize,
+    )
+
+    qdtype = kv_cache_jax_dtype(kv_dtype)
+    ks = jax.random.split(jax.random.key(seed), 3)
+    qshape = (b, n, d) if t == 1 else (b, t, n, d)
+    q = (jax.random.normal(ks[0], qshape, jnp.float32) * 0.5).astype(jnp.bfloat16)
+    kf = jax.random.normal(ks[1], (nb, bs, nkv, d), jnp.float32) * 0.5
+    vf = jax.random.normal(ks[2], (nb, bs, nkv, d), jnp.float32) * 0.5
+    kp, ksc = kv_quantize(kf, qdtype)
+    vp, vsc = kv_quantize(vf, qdtype)
+    rng = np.random.default_rng(seed)
+    nblk = -(-kv_limit // bs)
+    perm = rng.permutation(np.arange(1, nb))
+    tables = np.zeros((b, w), np.int32)
+    for i in range(b):
+        tables[i, :nblk] = perm[i * nblk:(i + 1) * nblk]
+    tables = jnp.asarray(tables)
+    positions = jnp.asarray(
+        rng.integers(0, kv_limit - t + 1, size=(b,)), jnp.int32
+    ).at[0].set(kv_limit - t)
+
+    def ref(q, kp, vp, ksc, vsc):
+        # dequantize outside, then the same dense gather the fp cases use
+        kd = kv_dequantize(kp, ksc, jnp.bfloat16)
+        vd = kv_dequantize(vp, vsc, jnp.bfloat16)
+        g = n // nkv
+        q4 = q[:, None] if t == 1 else q
+        jlog = jnp.arange(kv_limit)
+        phys = tables[:, jlog // bs] * bs + (jlog % bs)
+        kg = kd.reshape(nb * bs, nkv, d)[phys]
+        vg = vd.reshape(nb * bs, nkv, d)[phys]
+        qg = q4.reshape(b, t, nkv, g, d).astype(jnp.float32)
+        logits = jnp.einsum("bthgd,blhd->bthgl", qg, kg.astype(jnp.float32))
+        logits = logits / jnp.sqrt(jnp.float32(d))
+        mask = (
+            jlog[None, None, :]
+            <= positions[:, None, None] + jnp.arange(t)[None, :, None]
+        )[:, :, None, None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bthgl,blhd->bthgd", p, vg.astype(jnp.float32))
+        o = o.reshape(b, t, n, d)
+        return o[:, 0] if t == 1 else o
+
+    o_k = jax.jit(
+        lambda q, kp, vp, ksc, vsc: paged_flash_decode(
+            q, kp, vp, tables, positions,
+            kv_limit=kv_limit, num_splits=num_splits,
+            k_scale=ksc, v_scale=vsc,
+        )
+    )(q, kp, vp, ksc, vsc)
+    o_r = jax.jit(ref)(q, kp, vp, ksc, vsc)
+    o_k = np.asarray(o_k, np.float32)
+    o_r = np.asarray(o_r, np.float32)
+    denom = max(float(np.abs(o_r).max()), 1e-9)
+    rel = float(np.abs(o_k - o_r).max()) / denom
+    ok = rel < 5e-2  # quantized pool: dequant arithmetic differs in width
+    print(f"[{'ok' if ok else 'FAIL'}] {name}: rel_fwd={rel:.2e}")
+    return ok
+
+
 def _sharded_paged_case(
     name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed, t=1, tp=2
 ):
@@ -241,6 +323,21 @@ def main() -> int:
     ]
     for c in paged_cases:
         ok &= _paged_case(*c)
+    # quantized pool (PagedConfig.kv_cache_dtype): in-kernel dequant vs
+    # dequant-outside gather reference, int8 + both fp8s, t in {1,2,4,8}
+    #            name                 b  n  nkv d   nb  bs  w  L    spl sd  t
+    quant_cases = [
+        ("quant-paged-int8-t1", 4, 8, 2, 64, 33, 16, 8, 128, 4, 30, 1, "int8"),
+        ("quant-paged-int8-t2", 4, 8, 2, 64, 33, 16, 8, 128, 4, 31, 2, "int8"),
+        ("quant-paged-int8-t4", 3, 8, 2, 64, 33, 16, 8, 100, 2, 32, 4, "int8"),
+        ("quant-paged-int8-t8", 2, 4, 4, 64, 17, 16, 4, 64,  1, 33, 8, "int8"),
+        ("quant-paged-fp8e4m3-t1", 4, 8, 2, 64, 33, 16, 8, 128, 4, 34, 1, "fp8_e4m3"),
+        ("quant-paged-fp8e4m3-t8", 2, 4, 4, 64, 17, 16, 4, 64,  1, 35, 8, "fp8_e4m3"),
+        ("quant-paged-fp8e5m2-t1", 4, 8, 2, 64, 33, 16, 8, 128, 4, 36, 1, "fp8_e5m2"),
+        ("quant-paged-fp8e5m2-t4", 3, 8, 2, 64, 33, 16, 8, 100, 2, 37, 4, "fp8_e5m2"),
+    ]
+    for c in quant_cases:
+        ok &= _quant_paged_case(*c[:11], t=c[11], kv_dtype=c[12])
     # tp=2 head-sharded shard_map wrapping of the same kernel (serving's
     # multi-chip layout); nkv/n both divide tp in every case by design
     #                 name                  b  n  nkv d   nb  bs  w  L    spl sd  t
