@@ -610,6 +610,29 @@ class LlamaDecode:
             new_positions = jnp.minimum(new_positions, pos_cap)
         return logits[:, 0, :], new_positions, cache
 
+    @staticmethod
+    def finite_logit_check(
+        logits: jax.Array, poison_mask: Optional[jax.Array] = None
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Per-lane logit health check for the serving engine's "checked"
+        program variants (docs/serving.md "Failure handling & degradation"):
+        returns ``(logits, finite (b,) bool)`` where ``finite[i]`` is the
+        on-device ``isfinite`` reduction over lane i's logits — a single
+        boolean per lane rides the existing readback instead of shipping the
+        vocab axis to host. ``poison_mask`` (b,) int32 is the chaos-injection
+        hook: lanes with a nonzero mask get their logits overwritten with NaN
+        *before* the check (and before sampling / the accept rule), so fault
+        tests exercise the same detection path a genuine numerical blow-up
+        would take. ``poison_mask=None`` is static — the unchecked trace is
+        bitwise unchanged."""
+        if poison_mask is not None:
+            bad = (poison_mask > 0).reshape(
+                poison_mask.shape + (1,) * (logits.ndim - 1)
+            )
+            logits = jnp.where(bad, jnp.asarray(jnp.nan, logits.dtype), logits)
+        finite = jnp.all(jnp.isfinite(logits), axis=tuple(range(1, logits.ndim)))
+        return logits, finite
+
     def verify_step(
         self,
         params: Params,
@@ -621,7 +644,8 @@ class LlamaDecode:
         *,
         kv_limit: Optional[int] = None,
         pos_cap: Optional[int] = None,
-    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, PagedKVCache]:
+        logit_poison: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, ...]:
         """One speculative verify step: the greedy multi-token sibling of
         :meth:`decode_step`. The candidate block ``[cur, d_0 .. d_{k-1}]``
         is scored in ONE block-causal forward (writing its K/V at rows
@@ -643,6 +667,13 @@ class LlamaDecode:
         :mod:`.speculative`). Greedy-only: acceptance compares against
         ``argmax``, which is exactly ``sample()`` under
         ``SamplingConfig(greedy=True)``.
+
+        ``logit_poison`` (b,) int32 opts into the checked variant: logits
+        run through :meth:`finite_logit_check` *before* the accept rule and
+        the return grows a trailing-``finite`` element —
+        ``(emitted, accept, new_tokens, new_positions, finite, cache)``.
+        None (the default, static) keeps the unchecked trace bitwise
+        unchanged.
         """
         from neuronx_distributed_llama3_2_tpu.inference.speculative import (
             accept_rule,
@@ -652,6 +683,9 @@ class LlamaDecode:
             params, cache, tokens, positions, None,
             block_tables=block_tables, kv_limit=kv_limit,
         )
+        finite = None
+        if logit_poison is not None:
+            logits, finite = self.finite_logit_check(logits, logit_poison)
         # greedy[i, j] = target's token for row positions[i] + j + 1
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         accept, emitted = accept_rule(tokens[:, 1:], greedy, draft_len=draft_len)
@@ -659,6 +693,8 @@ class LlamaDecode:
         new_positions = positions + accept + 1
         if pos_cap is not None:
             new_positions = jnp.minimum(new_positions, pos_cap)
+        if finite is not None:
+            return emitted, accept, new_tokens, new_positions, finite, cache
         return emitted, accept, new_tokens, new_positions, cache
 
     def _paged_kernel_eligible(self, t: int, tree) -> bool:

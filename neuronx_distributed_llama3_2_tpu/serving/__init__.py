@@ -2,11 +2,15 @@
 
 See docs/serving.md. The dense slot-scheduled path
 (:class:`..inference.engine.ContinuousBatchingEngine`) is unchanged;
-:func:`make_serving_engine` selects between the two.
+:func:`make_serving_engine` selects between the two. Fault tolerance
+(chaos injection, failure domains, invariant audit, degradation ladder)
+lives in :mod:`.faults` / :mod:`.invariants` — see docs/serving.md
+"Failure handling & degradation".
 """
 
 from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     NULL_BLOCK,
+    AllocatorError,
     BlockAllocator,
 )
 from neuronx_distributed_llama3_2_tpu.serving.drafter import (
@@ -18,19 +22,38 @@ from neuronx_distributed_llama3_2_tpu.serving.engine import (
     PagedServingEngine,
     make_serving_engine,
 )
+from neuronx_distributed_llama3_2_tpu.serving.faults import (
+    FAULT_KINDS,
+    EngineStalledError,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+)
+from neuronx_distributed_llama3_2_tpu.serving.invariants import (
+    InvariantViolation,
+    audit_engine,
+)
 from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
     RadixPrefixIndex,
 )
 
 __all__ = [
+    "FAULT_KINDS",
     "NULL_BLOCK",
+    "AllocatorError",
     "BlockAllocator",
     "DraftProposer",
+    "EngineStalledError",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "InvariantViolation",
     "NGramDrafter",
     "PagedConfig",
     "PagedServingEngine",
     "RadixPrefixIndex",
     "ServingMetrics",
+    "audit_engine",
     "make_serving_engine",
 ]

@@ -34,6 +34,22 @@ from typing import Callable, Dict, List, Optional, Tuple
 NULL_BLOCK = 0
 
 
+class AllocatorError(RuntimeError):
+    """A refcount operation that can only come from caller state corruption:
+    double-``release``, ``incref`` on a freed id, an out-of-range block id.
+    Typed (carries ``bid`` and ``op``) so the serving engine's failure
+    handling can report *which* block's ownership went wrong instead of
+    surfacing a bare ``KeyError`` from dict internals."""
+
+    def __init__(self, bid: int, op: str, detail: str = ""):
+        self.bid = bid
+        self.op = op
+        msg = f"allocator {op} on block {bid}"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
 class BlockAllocator:
     """Ownership ledger for a pool of ``num_blocks`` fixed-size KV blocks."""
 
@@ -61,6 +77,10 @@ class BlockAllocator:
         self._cached: "OrderedDict[int, None]" = OrderedDict()
         self.evictions = 0
         self.cow_copies = 0
+        # chaos hook (serving/faults.py): when set and it returns True,
+        # alloc() reports transient exhaustion without touching the pool —
+        # drives the engine's back-off/preempt paths under a healthy pool
+        self.fault_hook: Optional[Callable[[], bool]] = None
 
     # -- introspection ----------------------------------------------------
 
@@ -107,12 +127,43 @@ class BlockAllocator:
             "cow_copies": self.cow_copies,
         }
 
+    def leak_check(self) -> List[int]:
+        """Block ids violating the pool partition invariant. Every usable id
+        must sit in exactly one of {free list, active refcounts, cached LRU},
+        active refcounts must be positive, and no free block may still be
+        registered in the prefix index. Returns the offending ids ([] =
+        clean); cheap enough for soak-test teardown and the invariant
+        auditor (serving/invariants.py)."""
+        bad: List[int] = []
+        seen: Dict[int, int] = {}
+        for bid in self._free:
+            seen[bid] = seen.get(bid, 0) + 1
+            if bid in self._registered:
+                bad.append(bid)  # freed while the index still maps it
+        for bid, n in self._ref.items():
+            seen[bid] = seen.get(bid, 0) + 1
+            if n <= 0:
+                bad.append(bid)
+        for bid in self._cached:
+            seen[bid] = seen.get(bid, 0) + 1
+            if bid not in self._registered:
+                bad.append(bid)  # parked without an index mapping
+        for bid in range(1, self.num_blocks):
+            if seen.get(bid, 0) != 1:
+                bad.append(bid)
+        for bid in seen:
+            if not 1 <= bid < self.num_blocks:
+                bad.append(bid)
+        return sorted(set(bad))
+
     # -- allocate / share / release ---------------------------------------
 
     def alloc(self) -> Optional[int]:
         """One block with refcount 1, evicting cached blocks LRU-first when
         the free list is empty. None = pool exhausted (every block is held
         by an active request)."""
+        if self.fault_hook is not None and self.fault_hook():
+            return None  # injected transient exhaustion; pool untouched
         while not self._free and self._cached:
             self._evict_one()
         if not self._free:
@@ -128,11 +179,19 @@ class BlockAllocator:
             del self._cached[bid]
             self._ref[bid] = 1
             return
-        self._ref[bid] += 1  # KeyError on a freed id = caller bug
+        if bid not in self._ref:
+            raise AllocatorError(
+                bid, "incref", "block is not allocated (freed id or stale table entry)"
+            )
+        self._ref[bid] += 1
 
     def release(self, bid: int) -> None:
         """Drop one reference. At zero the block parks in the cached LRU if
         the prefix index still maps it, else returns to the free list."""
+        if bid not in self._ref:
+            raise AllocatorError(
+                bid, "release", "block holds no references (double release?)"
+            )
         n = self._ref[bid] - 1
         if n > 0:
             self._ref[bid] = n

@@ -29,7 +29,14 @@ class DraftProposer(Protocol):
     def propose(self, history: Sequence[int], max_tokens: int) -> List[int]:
         """Return up to ``max_tokens`` draft tokens continuing ``history``
         (the lane's prompt + generated tokens so far, newest last). An
-        empty list abstains — the lane takes a plain decode step."""
+        empty list abstains — the lane takes a plain decode step.
+
+        Failure contract: drafting is *advisory*. The engine catches any
+        exception escaping ``propose`` (counted in
+        ``ServingMetrics.drafter_faults``), treats the lane as abstaining
+        for that step, and keeps serving — a drafter bug never fails a
+        request, so implementations should raise rather than return
+        made-up tokens when their internal state is suspect."""
         ...
 
 

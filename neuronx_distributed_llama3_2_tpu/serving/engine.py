@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -48,6 +49,11 @@ from neuronx_distributed_llama3_2_tpu.inference.engine import (
     InferenceEngine,
     pick_bucket,
     read_host_tokens,
+)
+from neuronx_distributed_llama3_2_tpu.serving.faults import (
+    EngineStalledError,
+    FaultInjector,
+    InjectedFault,
 )
 from neuronx_distributed_llama3_2_tpu.inference.sampling import (
     SamplingConfig,
@@ -126,6 +132,33 @@ class PagedConfig:
     # every step (the async pipeline only runs when speculation is off or
     # every active request is spec-disabled).
     spec_retry_steps: int = 4
+    # -- fault tolerance (docs/serving.md "Failure handling & degradation") --
+    # on-device finite-logit check: decode/verify programs grow a (B,) bool
+    # `finite` output and a lane whose logits go NaN/Inf is quarantined
+    # (terminal `failed`, blocks released) instead of committing garbage
+    # tokens. Off by default: the unchecked traces stay bitwise unchanged.
+    # A FaultInjector with nan faults turns this on implicitly.
+    detect_nonfinite: bool = False
+    # run the invariant auditor (serving/invariants.py) every N steps;
+    # violations are logged + counted in ServingMetrics.audit_violations.
+    # 0 = off (default — no audit cost on the serving path).
+    audit_interval: int = 0
+    # debug mode: audit strictly (raise InvariantViolation) at every
+    # finish / preempt / fail transition — for tests and soak teardowns
+    audit_debug: bool = False
+    # stall watchdog: consecutive step()s with work outstanding but zero
+    # progress (no tokens, no admissions, no finishes, no preemptions, no
+    # prefill movement) before step() raises EngineStalledError naming the
+    # stuck lanes. 0 = off (seed-compatible default; production fronts
+    # should set it so run_to_completion can never spin forever).
+    stall_step_limit: int = 0
+    # degradation ladder: after this many fault/pressure events inside a
+    # degrade_window_steps window, shed one feature rung (spec -> async
+    # lookahead -> paged kernel -> preempt-shed); each rung steps back up
+    # after degrade_recover_steps clean steps. 0 = ladder off (default).
+    degrade_after_faults: int = 0
+    degrade_window_steps: int = 64
+    degrade_recover_steps: int = 64
 
 
 @dataclasses.dataclass
@@ -154,6 +187,10 @@ class _PagedRequest:
     spec_drafted: int = 0
     spec_accepted: int = 0
     spec_disabled: bool = False
+    # terminal failure (fault injection, non-finite logits, device error):
+    # the request is done with partial output and `error` holds the detail
+    failed: bool = False
+    error: Optional[str] = None
 
 
 class PagedServingEngine:
@@ -169,11 +206,16 @@ class PagedServingEngine:
         paged: PagedConfig = PagedConfig(),
         precompile: bool = True,
         drafter: Optional[Any] = None,
+        injector: Optional[FaultInjector] = None,
     ) -> None:
         self.engine = engine
         self.model = engine.model
         self.gen = gen
         self.paged = paged
+        # chaos harness (serving/faults.py): None in production — every
+        # injector branch below is `is not None`-guarded so the fault-free
+        # path stays bitwise identical to an engine built without one
+        self.injector = injector
         bs = paged.block_size
         if bs < 1:
             raise ValueError("block_size must be positive")
@@ -256,6 +298,32 @@ class PagedServingEngine:
         self.allocator = BlockAllocator(paged.num_blocks, bs)
         self.index = RadixPrefixIndex(self.allocator)
         self.metrics = ServingMetrics()
+        # checked (finite-verified) program variants: separate _programs
+        # keys whose decode/verify traces add a (B,) poison-mask input and a
+        # (B,) `finite` output; selected by the knob or implied by a chaos
+        # plan that can fire nan faults
+        self._check_logits = bool(
+            paged.detect_nonfinite
+            or (injector is not None and injector.wants("nan"))
+        )
+        # cached device-resident all-zeros poison mask: the checked
+        # steady-state dispatch stays zero-upload (a mask uploads only on
+        # the steps a nan fault actually fires)
+        self._zero_mask = None
+        if injector is not None:
+            self.allocator.fault_hook = injector.alloc_fault
+        # degradation ladder state (docs/serving.md): level 0 = everything
+        # on; 1 sheds speculation, 2 the async lookahead, 3 the paged
+        # kernel (gather fallback via a config-twin model), 4 preempt-sheds
+        # the youngest lane on each further trip
+        self._degrade_level = 0
+        self._event_steps: deque = deque()  # step indices of recent events
+        self._last_event_step = 0
+        self._gather_model = None  # lazy use_paged_kernel=False twin
+        # stall watchdog state
+        self._step_index = 0
+        self._stall_steps = 0
+        self._last_progress_sig: Optional[tuple] = None
         # static pool-layout rows: under a tp mesh the kv-head-sharded pool
         # (paged_cache_specs) puts only NKV/tp heads on each chip, so the
         # same per-chip HBM holds a tp×-larger logical pool — the multi-chip
@@ -345,13 +413,35 @@ class PagedServingEngine:
 
     # -- programs ----------------------------------------------------------
 
+    def _step_model(self):
+        """The model instance new program traces bind: normally
+        ``self.model``; at degradation-ladder level >= 3 a lazily built
+        ``use_paged_kernel=False`` config twin, so every program compiled
+        on that rung takes the dense-gather fallback instead of the Pallas
+        kernel. The twin holds no weights (params ride in per call) and the
+        cache layout is identical, so switching rungs only changes which
+        cached program a dispatch picks."""
+        if self._degrade_level >= 3 and getattr(
+            self.model.config, "use_paged_kernel", False
+        ):
+            if self._gather_model is None:
+                self._gather_model = type(self.model)(
+                    dataclasses.replace(self.model.config, use_paged_kernel=False)
+                )
+            return self._gather_model
+        return self.model
+
+    def _gather_shed(self) -> bool:
+        """Program-cache key bit for the kernel-shed rung."""
+        return self._step_model() is not self.model
+
     def _prefill_ctx_program(self, bucket: int, cfg: SamplingConfig):
         """Whole-prompt prefill (no cached prefix): context-encode forward +
         last-token gather + on-device sample, paged writes."""
-        key_ = ("pctx", bucket, cfg)
+        key_ = ("pctx", bucket, cfg, self._gather_shed())
         if key_ in self._programs:
             return self._programs[key_]
-        model, engine = self.model, self.engine
+        model, engine = self._step_model(), self.engine
 
         def fn(params, cache, ids, length, table, key):
             params = engine._live_params(params)
@@ -376,10 +466,10 @@ class PagedServingEngine:
         position ``start`` (the cached length) and attends over the shared
         prefix blocks through the table — the cached tokens are never
         recomputed."""
-        key_ = ("psfx", bucket, kv_limit, cfg)
+        key_ = ("psfx", bucket, kv_limit, cfg, self._gather_shed())
         if key_ in self._programs:
             return self._programs[key_]
-        model, engine = self.model, self.engine
+        model, engine = self._step_model(), self.engine
 
         def fn(params, cache, ids, start, length, table, key):
             params = engine._live_params(params)
@@ -403,20 +493,37 @@ class PagedServingEngine:
         The cache and positions are donated (overwritten in place); tokens
         are NOT — the previous step's sampled-token array must stay alive
         for its (lagging) host readback while already feeding this
-        dispatch."""
-        key_ = ("pdecode", cfg, kv_limit)
+        dispatch.
+
+        The checked variant (``PagedConfig.detect_nonfinite`` / a nan-fault
+        chaos plan) adds a (B,) int32 poison-mask input and a (B,) bool
+        ``finite`` output via ``finite_logit_check`` — detection runs on
+        device and one bool per lane rides the existing readback. A
+        separate program key: the unchecked trace stays bitwise unchanged."""
+        checked = self._check_logits
+        key_ = ("pdecode", cfg, kv_limit, self._gather_shed(), checked)
         if key_ in self._programs:
             return self._programs[key_]
-        model, engine = self.model, self.engine
+        model, engine = self._step_model(), self.engine
         pos_cap = self._pos_cap
 
-        def fn(params, cache, tokens, positions, tables, key):
-            params = engine._live_params(params)
-            logits, new_positions, cache = model.decode_step(
-                params, cache, tokens, positions, tables,
-                kv_limit=kv_limit, pos_cap=pos_cap,
-            )
-            return sample(logits, key, cfg), new_positions, cache
+        if checked:
+            def fn(params, cache, tokens, positions, tables, key, nan_mask):
+                params = engine._live_params(params)
+                logits, new_positions, cache = model.decode_step(
+                    params, cache, tokens, positions, tables,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                )
+                logits, finite = model.finite_logit_check(logits, nan_mask)
+                return sample(logits, key, cfg), finite, new_positions, cache
+        else:
+            def fn(params, cache, tokens, positions, tables, key):
+                params = engine._live_params(params)
+                logits, new_positions, cache = model.decode_step(
+                    params, cache, tokens, positions, tables,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                )
+                return sample(logits, key, cfg), new_positions, cache
 
         self._programs[key_] = jax.jit(fn, donate_argnums=(1, 3))
         return self._programs[key_]
@@ -429,20 +536,33 @@ class PagedServingEngine:
         the plain decode program; the resident token array is not (it may
         still be a pending readback source) — the fresh drafts ride in as a
         separate (B, k) upload, the ONLY per-step host→device traffic
-        speculation adds."""
-        key_ = ("pverify", kv_limit, k)
+        speculation adds. Checked variant: poison mask in, trailing
+        ``finite`` out, applied *before* the accept rule (see
+        ``LlamaDecode.verify_step``)."""
+        checked = self._check_logits
+        key_ = ("pverify", kv_limit, k, self._gather_shed(), checked)
         if key_ in self._programs:
             return self._programs[key_]
-        model, engine = self.model, self.engine
+        model, engine = self._step_model(), self.engine
         pos_cap = self._pos_cap
 
-        def fn(params, cache, tokens, positions, tables, drafts, draft_len):
-            params = engine._live_params(params)
-            block = jnp.concatenate([tokens[:, None], drafts], axis=1)
-            return model.verify_step(
-                params, cache, block, positions, tables, draft_len,
-                kv_limit=kv_limit, pos_cap=pos_cap,
-            )
+        if checked:
+            def fn(params, cache, tokens, positions, tables, drafts,
+                   draft_len, nan_mask):
+                params = engine._live_params(params)
+                block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+                return model.verify_step(
+                    params, cache, block, positions, tables, draft_len,
+                    kv_limit=kv_limit, pos_cap=pos_cap, logit_poison=nan_mask,
+                )
+        else:
+            def fn(params, cache, tokens, positions, tables, drafts, draft_len):
+                params = engine._live_params(params)
+                block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+                return model.verify_step(
+                    params, cache, block, positions, tables, draft_len,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                )
 
         self._programs[key_] = jax.jit(fn, donate_argnums=(1, 3))
         return self._programs[key_]
@@ -488,7 +608,9 @@ class PagedServingEngine:
     def _upload(self, x, dtype=jnp.int32):
         """Every host→device transfer on the serving path funnels through
         here so the steady-state zero-upload property is countable (and
-        testable)."""
+        testable) — and so chaos latency spikes hit every transfer."""
+        if self.injector is not None:
+            self.injector.maybe_latency("upload")
         self.metrics.h2d_uploads += 1
         return jnp.asarray(x, dtype)
 
@@ -496,10 +618,209 @@ class PagedServingEngine:
         """Every device→host token readback funnels through here: one
         conversion, with the blocking wait accounted as device time
         (``ServingMetrics.device_wait_ms``)."""
+        if self.injector is not None:
+            self.injector.maybe_latency("read")
         t0 = time.perf_counter()
         arr = read_host_tokens(toks)
         self._wait_ms += (time.perf_counter() - t0) * 1e3
         return arr
+
+    # -- fault handling (docs/serving.md "Failure handling & degradation") --
+
+    def _chaos_device(self, site: str, lanes: Sequence[int]) -> None:
+        """Chaos funnel in front of a device program dispatch. Raising
+        *before* the call is what makes recovery tractable: the donated
+        cache and resident arrays are never half-mutated, so failing the
+        victim lane and redispatching the survivors is always sound. (A
+        *real* exception escaping a dispatch still propagates — after a
+        genuine mid-execution failure the donated buffers are gone and no
+        lane-scoped recovery is possible.)"""
+        if self.injector is None:
+            return
+        victim = self.injector.device_fault(site, lanes)
+        if victim is not None:
+            raise InjectedFault("device", site, lanes=(victim,))
+
+    def _nan_mask(self, lanes: Sequence[int], site: str):
+        """(B,) int32 poison mask for a checked dispatch: the cached
+        device-resident zeros array on clean steps (zero uploads), a fresh
+        upload only when the injector fires a nan fault."""
+        poison = (
+            self.injector.nan_lanes(site, lanes)
+            if self.injector is not None
+            else []
+        )
+        if not poison:
+            if self._zero_mask is None:
+                self._zero_mask = jnp.zeros(
+                    (self.engine.max_batch,), jnp.int32
+                )
+            return self._zero_mask
+        m = np.zeros((self.engine.max_batch,), np.int32)
+        m[poison] = 1
+        return self._upload(m)
+
+    def _fail_request(self, req: _PagedRequest, error: str) -> None:
+        """Terminal failure — the per-request failure domain. Mirrors
+        ``_preempt``'s teardown (blocks released, lane freed, mirrors
+        nulled + marked dirty for the next full-lane sync) but the request
+        never re-queues: it lands in ``_finished`` with ``failed=True``,
+        partial output intact, and ``error`` carrying the detail
+        (``request_info`` surfaces both). Nothing is registered in the
+        prefix index — a failed lane's tail blocks may hold garbage KV.
+        Only legal with no lookahead in flight (callers drain first)."""
+        assert self._pending is None, "failing a lane with a step in flight"
+        if req.rid in self._finished:
+            return
+        req.failed = True
+        req.done = True
+        req.error = str(error)
+        if req in self._queue:
+            self._queue.remove(req)
+        if req.lane is not None:
+            lane = req.lane
+            for b in req.table:
+                self.allocator.release(b)
+            req.table = []
+            req.table_dev = None
+            req.prefilling = False
+            del self._active[lane]
+            self._free_lanes.append(lane)
+            self._tables[lane, :] = NULL_BLOCK
+            self._tokens[lane] = 0
+            self._positions[lane] = 0
+            self._dirty_lanes.add(lane)
+            req.lane = None
+        self._finished[req.rid] = req
+        self.metrics.failed_requests += 1
+        self._note_event()
+        logger.warning(
+            "request %d failed after %d tokens: %s",
+            req.rid, len(req.out), req.error,
+        )
+        if self.paged.audit_debug:
+            self._audit(strict=True)
+
+    def _quarantine(self, req: _PagedRequest, site: str) -> None:
+        """Non-finite logits detected on this lane: its sampled token (and
+        any KV written from it) is garbage — fail the request instead of
+        committing. Companion lanes are untouched: per-lane attention means
+        their logits never saw the poisoned lane."""
+        self.metrics.lane_quarantines += 1
+        self._fail_request(
+            req, f"non-finite logits at {site} step (lane quarantined)"
+        )
+
+    def _recover_fault(self, fault: InjectedFault) -> bool:
+        """A device fault surfaced from a dispatch funnel: retire the
+        in-flight lookahead (its tokens are valid — it dispatched before
+        the fault), fail the victim lanes' requests, and keep serving.
+        Survivor lanes redispatch next step from untouched resident state."""
+        self._drain_pending()
+        failed_any = False
+        for lane in fault.lanes:
+            req = self._active.get(lane)
+            if req is not None:
+                self._fail_request(req, str(fault))
+                failed_any = True
+        if not failed_any:
+            self._note_event()  # _fail_request notes it otherwise
+        return bool(self._active or self._queue)
+
+    def _note_event(self) -> None:
+        """Record one fault/pressure event for the degradation ladder."""
+        self._last_event_step = self._step_index
+        if self.paged.degrade_after_faults:
+            self._event_steps.append(self._step_index)
+
+    def _update_ladder(self) -> None:
+        """Climb one rung when the event window saturates; step back down
+        after a clean recovery window. A climb consumes its window (events
+        re-accumulate before the next climb) and entering the top rung
+        preempt-sheds the youngest lane — deliberate load shedding, so that
+        preemption does not itself count as a pressure event."""
+        cfg = self.paged
+        if not cfg.degrade_after_faults:
+            return
+        horizon = self._step_index - cfg.degrade_window_steps
+        while self._event_steps and self._event_steps[0] <= horizon:
+            self._event_steps.popleft()
+        if len(self._event_steps) >= cfg.degrade_after_faults:
+            self._event_steps.clear()
+            self._last_event_step = self._step_index
+            if self._degrade_level < 4:
+                self._degrade_level += 1
+                self.metrics.degradations += 1
+                self.metrics.degradation_level = self._degrade_level
+                logger.warning(
+                    "degradation ladder: climbing to level %d",
+                    self._degrade_level,
+                )
+            if self._degrade_level >= 4 and len(self._active) > 1:
+                self._drain_pending()
+                victim = max(self._active.values(), key=lambda r: r.rid)
+                self._preempt(victim, shed=True)
+        elif (
+            self._degrade_level
+            and self._step_index - self._last_event_step
+            >= cfg.degrade_recover_steps
+        ):
+            self._degrade_level -= 1
+            self.metrics.degradation_level = self._degrade_level
+            # stagger further recovery: one rung per clean window
+            self._last_event_step = self._step_index
+            logger.info(
+                "degradation ladder: recovered to level %d", self._degrade_level
+            )
+
+    def _progress_sig(self) -> tuple:
+        """Everything that moves when the engine does useful work; two
+        consecutive equal signatures with work outstanding = a stalled
+        step."""
+        m = self.metrics
+        return (
+            m.admitted, m.finished, m.failed_requests, m.preemptions,
+            m.prefill_chunks, m.prefill_tokens, len(self._queue),
+            sum(len(r.out) for r in self._active.values()),
+            sum(r.prefill_pos for r in self._active.values() if r.prefilling),
+        )
+
+    def _check_stall(self) -> None:
+        limit = self.paged.stall_step_limit
+        if not limit:
+            return
+        if not (self._active or self._queue):
+            self._stall_steps = 0
+            self._last_progress_sig = None
+            return
+        sig = self._progress_sig()
+        if sig == self._last_progress_sig:
+            self._stall_steps += 1
+            if self._stall_steps >= limit:
+                raise EngineStalledError(
+                    limit,
+                    {lane: r.rid for lane, r in self._active.items()},
+                    [r.rid for r in self._queue],
+                )
+        else:
+            self._stall_steps = 0
+        self._last_progress_sig = sig
+
+    def _audit(self, strict: bool = False):
+        """Run the invariant auditor (serving/invariants.py); log + count
+        violations, raising only in strict (debug) mode."""
+        from neuronx_distributed_llama3_2_tpu.serving.invariants import (
+            InvariantViolation,
+            audit_engine,
+        )
+
+        violations = audit_engine(self)
+        if violations:
+            self.metrics.audit_violations += len(violations)
+            logger.error("serving invariant violations: %s", violations)
+            if strict:
+                raise InvariantViolation(violations)
+        return violations
 
     def _warmup(self) -> None:
         """Compile the decode program per kv bucket and the no-cache prefill
@@ -518,10 +839,14 @@ class PagedServingEngine:
             fn = self._decode_program(self.gen.sampling, kv)
             # positions are donated per call — hand each warmup its own
             # throwaway array; the resident state itself is untouched
-            _, _, self.cache = fn(
+            args = (
                 eng.params, self.cache, zeros_b,
                 jnp.zeros((eng.max_batch,), jnp.int32), self._d_tables, key,
             )
+            if self._check_logits:
+                _, _, _, self.cache = fn(*args, self._nan_mask((), "warmup"))
+            else:
+                _, _, self.cache = fn(*args)
         table1 = jnp.full((1, self.table_width), NULL_BLOCK, jnp.int32)
         for bucket in eng.buckets:
             fn = self._prefill_ctx_program(bucket, self.gen.sampling)
@@ -639,7 +964,14 @@ class PagedServingEngine:
                 continue
             suffix = seq[cached:]
             self._key, k = jax.random.split(self._key)
-            first = self._prefill(suffix, cached, table, k)
+            try:
+                self._chaos_device("prefill", (lane,))
+                first = self._prefill(suffix, cached, table, k)
+            except InjectedFault as fault:
+                # admission prefill fault: only this request dies — its
+                # lane/table teardown leaves the admission wave consistent
+                self._fail_request(req, str(fault))
+                continue
             req.out.append(first)
             req.position = len(seq)
             self._tokens[lane] = first
@@ -714,7 +1046,14 @@ class PagedServingEngine:
                 tbl = np.full((1, self.table_width), NULL_BLOCK, np.int32)
                 tbl[0, : len(req.table)] = req.table
                 req.table_dev = self._upload(tbl)
-            tok = self._prefill(piece, start, req.table, k, req.table_dev)
+            try:
+                self._chaos_device("prefill", (lane,))
+                tok = self._prefill(piece, start, req.table, k, req.table_dev)
+            except InjectedFault as fault:
+                # chunk fault: this lane's prefill walk dies, the other
+                # prefilling/decoding lanes are untouched
+                self._fail_request(req, str(fault))
+                continue
             req.prefill_pos = start + len(piece)
             self.metrics.prefill_tokens += len(piece)
             self.metrics.prefill_chunks += 1
@@ -736,10 +1075,13 @@ class PagedServingEngine:
                     self.index.insert(seq[: n_full * bs], req.table[:n_full])
             self._maybe_finish(req)
 
-    def _preempt(self, req: _PagedRequest) -> None:
+    def _preempt(self, req: _PagedRequest, shed: bool = False) -> None:
         """Pool exhausted: bump the request back to the queue head. Its
         registered prefix blocks park in the cached LRU, so re-admission
-        usually re-shares them instead of re-prefilling from scratch."""
+        usually re-shares them instead of re-prefilling from scratch.
+        A pool-pressure preemption counts as a degradation-ladder event;
+        the ladder's own top-rung load shedding (``shed=True``) does not —
+        deliberate shedding must not retrigger the ladder."""
         lane = req.lane
         for b in req.table:
             self.allocator.release(b)
@@ -761,10 +1103,14 @@ class PagedServingEngine:
         self._queue.insert(0, req)
         req.preemptions += 1
         self.metrics.preemptions += 1
+        if not shed:
+            self._note_event()  # sustained pool pressure feeds the ladder
         logger.debug(
             "preempted request %d (pool exhausted): %d generated so far",
             req.rid, len(req.out),
         )
+        if self.paged.audit_debug:
+            self._audit(strict=True)
 
     def _ensure_decode_blocks(self) -> None:
         """Every active lane's next write row must be backed by a real
@@ -852,6 +1198,8 @@ class PagedServingEngine:
             req.lane = None
         self._finished[req.rid] = req
         self.metrics.finished += 1
+        if self.paged.audit_debug:
+            self._audit(strict=True)
 
     # -- serving loop -------------------------------------------------------
 
@@ -894,14 +1242,27 @@ class PagedServingEngine:
         lanes (for them it is an ordinary decode step), discard the finished
         lanes' post-EOS tokens, and only then release the finished lanes'
         blocks — device program order guarantees the lame-duck KV writes
-        landed before any later program can touch the recycled blocks."""
-        toks, lanes, idx = pending
+        landed before any later program can touch the recycled blocks.
+
+        A lane whose checked dispatch reported non-finite logits commits
+        nothing (its sampled token is garbage) and is quarantined exactly
+        like a finishing lane: the in-flight lookahead — which dispatched
+        from the garbage resident token — drains as *its* lame-duck step
+        and the lane's request fails terminally."""
+        toks, lanes, idx, finite = pending
         arr = self._read_tokens(toks)
+        fin = None if finite is None else self._read_tokens(finite)
         self._last_readback_lag = self._dispatch_count - idx
         eng = self.engine
         finishing: List[_PagedRequest] = []
+        quarantined: List[_PagedRequest] = []
         for lane in lanes:
-            req = self._active[lane]
+            req = self._active.get(lane)
+            if req is None:
+                continue  # lane torn down between dispatch and readback
+            if fin is not None and not bool(fin[lane]):
+                quarantined.append(req)
+                continue
             req.out.append(int(arr[lane]))
             req.position += 1
             self._tokens[lane] = arr[lane]
@@ -909,19 +1270,23 @@ class PagedServingEngine:
                 req.done = True
             if self._finish_due(req):
                 finishing.append(req)
-        if finishing and self._pending is not None:
+        if (finishing or quarantined) and self._pending is not None:
             # Lame-duck drain: the lookahead step already ran with the
-            # finished lanes still in the batch.
-            toks2, lanes2, idx2 = self._pending
+            # finished (or quarantined) lanes still in the batch.
+            toks2, lanes2, idx2, finite2 = self._pending
             self._pending = None
             arr2 = self._read_tokens(toks2)
+            fin2 = None if finite2 is None else self._read_tokens(finite2)
             self._last_readback_lag = self._dispatch_count - idx2
-            dead = {r.lane for r in finishing}
+            dead = {r.lane for r in finishing} | {r.lane for r in quarantined}
             for lane in lanes2:
                 if lane in dead:
                     self.metrics.lame_duck_tokens += 1
-                    continue  # discard the post-finish token
+                    continue  # discard the post-finish/post-poison token
                 req = self._active[lane]
+                if fin2 is not None and not bool(fin2[lane]):
+                    quarantined.append(req)
+                    continue
                 req.out.append(int(arr2[lane]))
                 req.position += 1
                 self._tokens[lane] = arr2[lane]
@@ -931,6 +1296,8 @@ class PagedServingEngine:
                     finishing.append(req)
         for req in finishing:
             self._maybe_finish(req)
+        for req in quarantined:
+            self._quarantine(req, "decode")
 
     def _drain_pending(self) -> None:
         """Retire the in-flight lookahead step (if any) before the
@@ -957,20 +1324,29 @@ class PagedServingEngine:
         decode_lanes = [
             l for l, r in self._active.items() if not r.prefilling
         ]
+        self._chaos_device("decode", decode_lanes)
         eng = self.engine
         kv_limit = eng._kv_bucket(
             int(max(self._positions[l] for l in decode_lanes)) + 1
         )
         fn = self._decode_program(self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)
-        toks, self._d_positions, self.cache = fn(
-            eng.params, self.cache,
-            self._d_tokens, self._d_positions, self._d_tables, k,
-        )
+        finite = None
+        if self._check_logits:
+            toks, finite, self._d_positions, self.cache = fn(
+                eng.params, self.cache,
+                self._d_tokens, self._d_positions, self._d_tables, k,
+                self._nan_mask(decode_lanes, "decode"),
+            )
+        else:
+            toks, self._d_positions, self.cache = fn(
+                eng.params, self.cache,
+                self._d_tokens, self._d_positions, self._d_tables, k,
+            )
         self._d_tokens = toks
         self._dispatch_count += 1
         prev, self._pending = self._pending, (
-            toks, decode_lanes, self._dispatch_count,
+            toks, decode_lanes, self._dispatch_count, finite,
         )
         for lane in decode_lanes:
             self._positions[lane] += 1  # mirror the on-device advance
@@ -1002,6 +1378,7 @@ class PagedServingEngine:
         ]
         if not decode_lanes:
             return bool(self._active or self._queue)  # re-admit next step
+        self._chaos_device("decode", decode_lanes)
         self._flush_state()
         eng = self.engine
         kv_limit = eng._kv_bucket(
@@ -1009,16 +1386,24 @@ class PagedServingEngine:
         )
         fn = self._decode_program(self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)
-        toks, self._d_positions, self.cache = fn(
-            eng.params, self.cache,
-            self._d_tokens, self._d_positions, self._d_tables, k,
-        )
+        finite = None
+        if self._check_logits:
+            toks, finite, self._d_positions, self.cache = fn(
+                eng.params, self.cache,
+                self._d_tokens, self._d_positions, self._d_tables, k,
+                self._nan_mask(decode_lanes, "decode"),
+            )
+        else:
+            toks, self._d_positions, self.cache = fn(
+                eng.params, self.cache,
+                self._d_tokens, self._d_positions, self._d_tables, k,
+            )
         self._d_tokens = toks
         self._dispatch_count += 1
         for lane in decode_lanes:
             self._positions[lane] += 1
         self.metrics.decode_steps += 1
-        self._read_and_apply((toks, decode_lanes, self._dispatch_count))
+        self._read_and_apply((toks, decode_lanes, self._dispatch_count, finite))
         return bool(self._active or self._queue)
 
     # -- speculative decoding ----------------------------------------------
@@ -1040,7 +1425,20 @@ class PagedServingEngine:
             limit = min(k, remaining - 1)
             if limit < 1:
                 continue
-            drafts = self.drafter.propose(req.prompt + req.out, limit)
+            try:
+                if self.injector is not None:
+                    self.injector.drafter_fault()
+                drafts = self.drafter.propose(req.prompt + req.out, limit)
+            except Exception as exc:
+                # drafting is advisory: a drafter bug (or injected fault)
+                # costs this lane its speculation for one step, never the
+                # request — the lane degrades to a plain decode step
+                self.metrics.drafter_faults += 1
+                self._note_event()
+                logger.warning(
+                    "drafter failed for request %d: %s", req.rid, exc
+                )
+                continue
             if drafts:
                 out[lane] = list(drafts[:limit])
         return out
@@ -1100,6 +1498,7 @@ class PagedServingEngine:
         decode_lanes = [
             l for l, r in self._active.items() if not r.prefilling
         ]
+        self._chaos_device("verify", decode_lanes)
         self._flush_state()
         eng = self.engine
         k = self._spec_k
@@ -1112,11 +1511,23 @@ class PagedServingEngine:
             int(max(self._positions[l] for l in decode_lanes)) + k + 1
         )
         fn = self._verify_program(kv_limit, k)
-        emitted_d, accept_d, new_tokens, self._d_positions, self.cache = fn(
-            eng.params, self.cache,
-            self._d_tokens, self._d_positions, self._d_tables,
-            self._upload(drafts), self._upload(draft_len),
-        )
+        if self._check_logits:
+            (
+                emitted_d, accept_d, new_tokens, self._d_positions,
+                finite_d, self.cache,
+            ) = fn(
+                eng.params, self.cache,
+                self._d_tokens, self._d_positions, self._d_tables,
+                self._upload(drafts), self._upload(draft_len),
+                self._nan_mask(decode_lanes, "verify"),
+            )
+        else:
+            finite_d = None
+            emitted_d, accept_d, new_tokens, self._d_positions, self.cache = fn(
+                eng.params, self.cache,
+                self._d_tokens, self._d_positions, self._d_tables,
+                self._upload(drafts), self._upload(draft_len),
+            )
         self._d_tokens = new_tokens
         self._dispatch_count += 1
         self.metrics.decode_steps += 1
@@ -1124,11 +1535,18 @@ class PagedServingEngine:
         self.metrics.draft_tokens += int(draft_len.sum())
         emitted = self._read_tokens(emitted_d)      # (B, k+1)
         accept = self._read_tokens(accept_d)        # (B,)
+        fin = None if finite_d is None else self._read_tokens(finite_d)
         self._last_readback_lag = 0
         cfg = self.paged
         finishing: List[_PagedRequest] = []
+        quarantined: List[_PagedRequest] = []
         for lane in decode_lanes:
             req = self._active[lane]
+            if fin is not None and not bool(fin[lane]):
+                # poisoned verify: every emitted token and the accept
+                # length are garbage — commit nothing on this lane
+                quarantined.append(req)
+                continue
             a = int(accept[lane])
             self.metrics.accepted_tokens += a
             req.spec_drafted += int(draft_len[lane])
@@ -1156,21 +1574,28 @@ class PagedServingEngine:
                 self.metrics.spec_disabled_lanes += 1
         for req in finishing:
             self._maybe_finish(req)
+        for req in quarantined:
+            self._quarantine(req, "verify")
         return bool(self._active or self._queue), True
 
     def _step_inner(self) -> bool:
-        if self._spec_k and self._spec_pause <= 0:
+        # degradation ladder: rung 1 sheds speculation, rung 2 the async
+        # lookahead (rung 3 — the paged kernel — is applied at program
+        # selection, rung 4 at _update_ladder)
+        spec_on = self._spec_k and self._degrade_level < 1
+        async_on = self.paged.async_loop and self._degrade_level < 2
+        if spec_on and self._spec_pause <= 0:
             self._drain_pending()
             alive, drafted = self._step_spec()
             # a dry drafter hands the loop to the async lookahead for a few
             # steps (spec_retry_steps) instead of pinning it to sync mode;
             # with async off there is nothing to yield to — retry every step
-            if not drafted and self.paged.async_loop:
+            if not drafted and async_on:
                 self._spec_pause = self.paged.spec_retry_steps
             return alive
         if self._spec_pause > 0:
             self._spec_pause -= 1
-        if self.paged.async_loop and self._async_eligible():
+        if async_on and self._async_eligible():
             if self._ensure_decode_blocks_async():
                 return self._step_async()
             # Pool dry: the scheduler must preempt, which mutates lane
@@ -1187,30 +1612,71 @@ class PagedServingEngine:
         ``PagedConfig.async_loop`` the steady-state decode path runs a
         depth-1 lookahead pipeline (docs/serving.md "Async step pipeline");
         note per-request state then trails the device by one step until the
-        pipeline drains. Returns False when nothing is left to do."""
+        pipeline drains. Returns False when nothing is left to do.
+
+        Failure domains: an injected device fault aborts only its victim
+        lanes (terminal ``failed`` status, blocks released, survivors
+        redispatch from untouched resident state); repeated faults or
+        sustained pool pressure climb the degradation ladder; a configured
+        ``stall_step_limit`` raises :class:`EngineStalledError` instead of
+        letting :meth:`run_to_completion` spin on a wedged lane."""
         t0 = time.perf_counter()
         self._wait_ms = 0.0
-        alive = self._step_inner()
+        self._step_index += 1
+        if self.injector is not None:
+            self.injector.begin_step(self._step_index)
+        try:
+            alive = self._step_inner()
+        except InjectedFault as fault:
+            alive = self._recover_fault(fault)
+        if self.injector is not None:
+            self.metrics.faults_injected = self.injector.total_fired
         total_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.device_wait_ms += self._wait_ms
         self.metrics.host_schedule_ms += max(total_ms - self._wait_ms, 0.0)
+        self._update_ladder()
+        if (
+            self.paged.audit_interval
+            and self._step_index % self.paged.audit_interval == 0
+        ):
+            self._audit(strict=False)
         every = self.paged.metrics_log_every
         steps = self.metrics.decode_steps
         if every and steps and steps % every == 0 and steps != self._last_log_step:
             self._last_log_step = steps
             self.metrics.log(logger, self.allocator, self.index)
+        self._check_stall()
         return alive
 
     def run_to_completion(self) -> Dict[int, List[int]]:
+        """Step until idle. Requests that failed terminally (chaos, NaN
+        quarantine) are included with their partial output — check
+        ``request_info(rid)["status"]`` to tell them apart. Bounded by the
+        stall watchdog when ``PagedConfig.stall_step_limit`` is set."""
         while self.step():
             pass
         return {rid: r.out for rid, r in sorted(self._finished.items())}
+
+    @staticmethod
+    def _status(req: _PagedRequest) -> str:
+        """Lifecycle status ∈ {queued, prefilling, active, preempted,
+        finished, failed}."""
+        if req.failed:
+            return "failed"
+        if req.done:
+            return "finished"
+        if req.lane is None:
+            return "preempted" if req.preemptions else "queued"
+        return "prefilling" if req.prefilling else "active"
 
     def request_info(self, rid: int) -> dict:
         """Per-request serving stats (``cached_tokens`` is the per-request
         prefix-cache report the protocol layer surfaces). O(1): every
         request lives in ``_requests`` from submit() on, whatever lifecycle
-        state it is in (queued / active / prefilling / preempted / finished)."""
+        state it is in. ``status`` is the lifecycle state; ``error`` holds
+        the failure detail for ``status == "failed"`` (else None). The
+        ``done``/``prefilling`` booleans predate ``status`` and are kept
+        for callers that grew around them."""
         req = self._requests.get(rid)
         if req is None:
             raise KeyError(f"unknown request id {rid}")
@@ -1222,6 +1688,8 @@ class PagedServingEngine:
             "preemptions": req.preemptions,
             "prefilling": req.prefilling,
             "done": req.done,
+            "status": self._status(req),
+            "error": req.error,
         }
 
 
@@ -1231,17 +1699,22 @@ def make_serving_engine(
     paged: Optional[PagedConfig] = None,
     precompile: bool = True,
     drafter: Optional[Any] = None,
+    injector: Optional[FaultInjector] = None,
 ):
     """The serving-path config flag: ``paged=None`` keeps the dense
     slot-scheduled engine; a :class:`PagedConfig` opts into the block pool
     + radix prefix caching (``drafter`` overrides the default n-gram
-    proposer when ``spec_draft_tokens`` is set)."""
+    proposer when ``spec_draft_tokens`` is set; ``injector`` hooks a chaos
+    :class:`FaultInjector` into the paged engine's funnels)."""
     if paged is None:
+        if injector is not None:
+            raise ValueError("fault injection requires the paged engine")
         from neuronx_distributed_llama3_2_tpu.inference.engine import (
             ContinuousBatchingEngine,
         )
 
         return ContinuousBatchingEngine(engine, gen, precompile=precompile)
     return PagedServingEngine(
-        engine, gen, paged, precompile=precompile, drafter=drafter
+        engine, gen, paged, precompile=precompile, drafter=drafter,
+        injector=injector,
     )

@@ -1,0 +1,168 @@
+"""Invariant auditor for the paged serving engine's host-side state.
+
+The engine's correctness rests on a web of cross-structure invariants —
+block refcounts conserved across request tables, the radix index, and the
+allocator's free/cached partition; block-table mirrors agreeing with
+request bookkeeping; decode frontiers inside the pool — that no single
+module can check alone. :func:`audit_engine` walks all of it in one pass
+and returns human-readable violation strings ([] = clean).
+
+Host-only by design: nothing here reads a device array, so an audit never
+forces a sync, never perturbs the async lookahead (the depth-1 lag is
+*modeled*, not drained), and costs O(active lanes × table width) python —
+microseconds against a multi-ms decode step. The engine runs it every
+``PagedConfig.audit_interval`` steps (metric-counted, non-fatal) and
+strictly at finish/preempt/fail under ``audit_debug``; soak tests call it
+at teardown alongside ``BlockAllocator.leak_check``.
+
+Invariants checked:
+
+1. Pool partition — every usable block id in exactly one of {free, active
+   refcounts, cached LRU}; no free block still registered; cached blocks
+   all registered (``BlockAllocator.leak_check``).
+2. Refcount conservation — each block's refcount equals the number of
+   active request tables addressing it (prefix sharing is the only
+   legitimate source of refcount > 1).
+3. Table validity — in-range non-null ids, no duplicate within one table,
+   host mirror rows matching: installed tables for decode-ready lanes,
+   all-NULL decode-invisible rows for mid-chunked-prefill lanes and free
+   lanes.
+4. Lane bookkeeping — active lanes and the free-lane list partition the
+   batch; ``req.lane`` round-trips.
+5. Frontier/position sanity — ``req.position == len(prompt + out) - 1``
+   for decode-ready lanes; the dispatch-frontier mirror leads it by
+   exactly the in-flight lookahead depth (1 while pending, else 0);
+   positions sit inside the table's backing.
+6. Radix coherence — every indexed node's block is allocator-registered
+   and maps back to its node; parent/child links are consistent.
+7. Scale-array presence — the cache carries k/v scale arrays iff
+   ``PagedConfig.kv_cache_dtype`` is quantized.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
+    NULL_BLOCK,
+)
+
+
+class InvariantViolation(AssertionError):
+    """Raised by the engine's strict (debug-mode) audits; carries the full
+    violation list."""
+
+    def __init__(self, violations: List[str]):
+        self.violations = list(violations)
+        super().__init__(
+            f"{len(self.violations)} serving invariant violation(s): "
+            + "; ".join(self.violations)
+        )
+
+
+def audit_engine(engine) -> List[str]:
+    """Audit one :class:`.engine.PagedServingEngine`. Returns violation
+    strings, [] when every invariant holds. Never raises, never touches
+    device arrays."""
+    v: List[str] = []
+    alloc = engine.allocator
+    index = engine.index
+    nb = alloc.num_blocks
+
+    # 1. pool partition
+    for bid in alloc.leak_check():
+        v.append(f"pool partition violated at block {bid}")
+
+    # 2. refcount conservation vs active tables
+    expected: dict = {}
+    for req in engine._active.values():
+        for b in req.table:
+            expected[b] = expected.get(b, 0) + 1
+    for b, n in expected.items():
+        if alloc.refcount(b) != n:
+            v.append(
+                f"block {b}: refcount {alloc.refcount(b)} != {n} table refs"
+            )
+    for b, n in alloc._ref.items():
+        if b not in expected:
+            v.append(f"block {b}: refcount {n} but no active table holds it")
+
+    # 3 + 4 + 5. lanes, tables, frontiers
+    pending_lanes = set(engine._pending[1]) if engine._pending else set()
+    max_batch = engine.engine.max_batch
+    active_lanes = set(engine._active.keys())
+    free_lanes = set(engine._free_lanes)
+    if active_lanes & free_lanes:
+        v.append(f"lanes both active and free: {sorted(active_lanes & free_lanes)}")
+    if active_lanes | free_lanes != set(range(max_batch)):
+        v.append(
+            f"lane partition broken: active {sorted(active_lanes)} + free "
+            f"{sorted(free_lanes)} != 0..{max_batch - 1}"
+        )
+    for lane in free_lanes - engine._dirty_lanes:
+        if (engine._tables[lane] != NULL_BLOCK).any():
+            v.append(f"free lane {lane}: table mirror row not all-NULL")
+    for lane, req in engine._active.items():
+        if req.lane != lane:
+            v.append(f"lane {lane}: request {req.rid} thinks it is on lane {req.lane}")
+        if len(set(req.table)) != len(req.table):
+            v.append(f"rid {req.rid}: duplicate block in table {req.table}")
+        for b in req.table:
+            if not 1 <= b < nb:
+                v.append(f"rid {req.rid}: table holds invalid block id {b}")
+        row = engine._tables[lane]
+        if lane in engine._dirty_lanes:
+            pass  # mirror queued for rewrite; skip the row checks
+        elif req.prefilling:
+            if (row != NULL_BLOCK).any():
+                v.append(
+                    f"rid {req.rid}: decode-visible table row live "
+                    "mid-chunked-prefill"
+                )
+        else:
+            w = len(req.table)
+            if list(row[:w]) != req.table:
+                v.append(
+                    f"rid {req.rid}: table mirror row {list(row[:w])} != "
+                    f"table {req.table}"
+                )
+            if (row[w:] != NULL_BLOCK).any():
+                v.append(f"rid {req.rid}: mirror row live past table end")
+            want = len(req.prompt) + len(req.out) - 1
+            if req.position != want:
+                v.append(
+                    f"rid {req.rid}: position {req.position} != "
+                    f"len(prompt + out) - 1 = {want}"
+                )
+            lag = int(engine._positions[lane]) - req.position
+            want_lag = 1 if lane in pending_lanes else 0
+            if lag != want_lag:
+                v.append(
+                    f"rid {req.rid}: dispatch frontier lag {lag} != {want_lag}"
+                )
+            if int(engine._positions[lane]) > engine._pos_cap:
+                v.append(f"rid {req.rid}: frontier past the table's last row")
+            if req.position >= engine.engine.max_seq_len:
+                v.append(
+                    f"rid {req.rid}: position {req.position} past max_seq_len"
+                )
+
+    # 6. radix coherence
+    for bid, node in index._by_block.items():
+        if node.block != bid:
+            v.append(f"radix node for block {bid} claims block {node.block}")
+        if not alloc.is_registered(bid):
+            v.append(f"radix-indexed block {bid} not registered in allocator")
+        if node.parent is not None and node.parent.children.get(node.key) is not node:
+            v.append(f"radix node for block {bid}: broken parent link")
+
+    # 7. scale arrays match the configured pool dtype
+    quant = engine.paged.kv_cache_dtype != "bf16"
+    has_k = getattr(engine.cache, "k_scale", None) is not None
+    has_v = getattr(engine.cache, "v_scale", None) is not None
+    if quant != has_k or quant != has_v:
+        v.append(
+            f"kv_cache_dtype={engine.paged.kv_cache_dtype!r} but cache "
+            f"scale arrays present=(k={has_k}, v={has_v})"
+        )
+    return v

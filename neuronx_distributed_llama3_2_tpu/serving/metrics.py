@@ -61,6 +61,14 @@ class ServingMetrics:
     verify_steps: int = 0          # of decode_steps, multi-token verifies
     spec_disabled_lanes: int = 0   # requests dropped to plain decode (low
     #                                accept rate past probation)
+    # -- fault tolerance (docs/serving.md "Failure handling & degradation") --
+    faults_injected: int = 0       # chaos events fired by the FaultInjector
+    failed_requests: int = 0       # requests ended in terminal `failed`
+    lane_quarantines: int = 0      # lanes failed on non-finite logits
+    drafter_faults: int = 0        # drafter exceptions absorbed (advisory)
+    degradation_level: int = 0     # current ladder rung (gauge, 0 = full)
+    degradations: int = 0          # ladder climbs taken (cumulative)
+    audit_violations: int = 0      # invariant-auditor findings (cumulative)
 
     def prefix_skip_fraction(self) -> float:
         """Fraction of admitted prompt tokens that skipped prefill."""

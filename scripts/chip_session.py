@@ -81,6 +81,11 @@ STAGES = [
     # steps/sec A/B (all parity-gated; timings recorded)
     ("paged_decode",
      [PY, os.path.join(REPO, "scripts", "paged_decode_bench.py")], 1200),
+    # chaos soak: every fault class against the full-featured serving
+    # engine, gated on parity-of-unaffected-requests + zero leaks + clean
+    # invariant audits (scripts/chaos_soak.py; fast CPU smoke in tier-1)
+    ("chaos_soak",
+     [PY, os.path.join(REPO, "scripts", "chaos_soak.py")], 600),
     ("churn_1b",
      [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
       "--stage", "churn", "--model", "llama3.2-1b"], 900),

@@ -30,6 +30,7 @@ from neuronx_distributed_llama3_2_tpu.models.llama import (
 from neuronx_distributed_llama3_2_tpu.serving import (
     PagedConfig,
     PagedServingEngine,
+    audit_engine,
 )
 
 from tests.test_paged_serving import _dense_outputs, _prompts
@@ -58,6 +59,8 @@ def _run(paged, prompts):
     # drained pipeline + clean pool, whatever the path taken
     assert paged._pending is None
     assert paged.allocator.active_blocks == 0
+    assert paged.allocator.leak_check() == []
+    assert audit_engine(paged) == []
     return out
 
 
@@ -172,6 +175,8 @@ def test_soak_randomized_schedule_token_identical(params):
             assert steps < 3000, "soak did not converge"
         assert paged._pending is None
         assert paged.allocator.active_blocks == 0
+        assert paged.allocator.leak_check() == []
+        assert audit_engine(paged) == []
         assert paged.metrics.finished == n_requests
         return {r: req.out for r, req in paged._finished.items()}, steps, paged.metrics
 
@@ -228,6 +233,8 @@ def test_soak_spec_randomized_schedule(params, model_cfg, chunk):
         assert steps < 3000, "spec soak did not converge"
     assert paged._pending is None
     assert paged.allocator.active_blocks == 0
+    assert paged.allocator.leak_check() == []
+    assert audit_engine(paged) == []
     assert paged.metrics.finished == n_requests
     out = {r: paged._finished[r].out for r in sorted(paged._finished)}
     assert out == _dense_outputs(params, prompts, gen)

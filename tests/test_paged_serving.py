@@ -24,6 +24,7 @@ from neuronx_distributed_llama3_2_tpu.models.llama import (
 from neuronx_distributed_llama3_2_tpu.serving import (
     PagedConfig,
     PagedServingEngine,
+    audit_engine,
     make_serving_engine,
 )
 
@@ -68,6 +69,8 @@ def test_paged_matches_dense_on_mixed_length_batch(params):
     m = paged.metrics
     assert m.finished == len(prompts)
     assert paged.allocator.active_blocks == 0  # everything released
+    assert paged.allocator.leak_check() == []
+    assert audit_engine(paged) == []
 
 
 def test_prefix_reuse_reports_cached_tokens_and_stays_equivalent(params):

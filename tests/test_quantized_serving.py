@@ -41,6 +41,7 @@ from neuronx_distributed_llama3_2_tpu.quantization import (
 from neuronx_distributed_llama3_2_tpu.serving import (
     PagedConfig,
     PagedServingEngine,
+    audit_engine,
 )
 from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     kv_pool_bytes_per_rank,
@@ -315,6 +316,9 @@ def test_quantized_steady_state_is_fully_resident(params):
         assert (m.h2d_uploads, m.lane_syncs, m.table_deltas) == before
         assert paged._last_readback_lag == 1
     paged.run_to_completion()
+    # quantized teardown: pool drained, scale arrays still matching dtype
+    assert paged.allocator.leak_check() == []
+    assert audit_engine(paged) == []
 
 
 # -- tensor parallel -------------------------------------------------------

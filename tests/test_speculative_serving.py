@@ -35,6 +35,7 @@ from neuronx_distributed_llama3_2_tpu.serving import (
     NGramDrafter,
     PagedConfig,
     PagedServingEngine,
+    audit_engine,
 )
 
 from tests.test_paged_serving import _dense_outputs, _prompts
@@ -71,6 +72,8 @@ def _run(paged, prompts):
     out = paged.run_to_completion()
     assert paged._pending is None
     assert paged.allocator.active_blocks == 0
+    assert paged.allocator.leak_check() == []
+    assert audit_engine(paged) == []
     return out
 
 
