@@ -1,8 +1,20 @@
-"""Static analysis for sharding/trace safety (shardlint).
+"""Static analysis for sharding/trace safety.
 
-The analyzer is pure-AST: it never imports the modules it checks, so it
-runs on any host (no TPU, no jax initialization) and in CI as a plain
-pytest. See docs/static_analysis.md for the rule catalogue.
+Two analyzers, two layers of the same story (docs/static_analysis.md):
+
+- ``shardlint`` is pure-AST: it never imports the modules it checks, so
+  it runs on any host (no TPU, no jax initialization) and in CI as a
+  plain pytest.
+- ``graftcheck`` analyzes what the tracer/compiler actually produced —
+  jaxprs and lowered programs. It imports jax (to trace) but never
+  executes a program, so it too runs on the CPU tier.
+
+graftcheck names (``GC_RULES``, ``audit_programs``, the ``check_*``
+rules) are intentionally NOT re-exported here: its callers hold jaxprs
+and lowered programs already, and the shardlint surface must stay
+importable with zero jax involvement (graftcheck itself defers its jax
+imports to call time). Use
+``from neuronx_distributed_llama3_2_tpu.analysis import graftcheck``.
 """
 
 from neuronx_distributed_llama3_2_tpu.analysis.shardlint import (

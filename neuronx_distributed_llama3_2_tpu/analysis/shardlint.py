@@ -65,6 +65,7 @@ RULES: Dict[str, str] = {
     "SL004": "host-side effect inside a jit/shard_map/scan-traced body",
     "SL005": "raw with_sharding_constraint inside a shard_map body",
     "SL006": "axis_index/axis_size axis not bound by enclosing shard_map",
+    "SL007": "ad-hoc donated jax.jit in serving/ outside _register_program",
 }
 
 # functions whose result depends on the live parallel layout: calling one
@@ -753,6 +754,53 @@ def _rule_sl006(ctx: _ModuleContext) -> List[Finding]:
     return out
 
 
+def _rule_sl007(ctx: _ModuleContext) -> List[Finding]:
+    """Donated jits on the serving path must go through the engine's
+    ``_register_program`` registry: ``graftcheck.audit_programs`` audits
+    exactly the ``_programs`` population (donation aliasing, host
+    transfers, purity), so a ``jax.jit(..., donate_argnums=...)`` created
+    anywhere else in ``serving/`` is a compiled, buffer-stealing program
+    the auditor can never see."""
+    norm = ctx.path.replace(os.sep, "/")
+    if "/serving/" not in norm and not norm.startswith("serving/"):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = ctx.resolve_chain(node.func).rsplit(".", 1)[-1]
+        if tail != "jit":
+            continue
+        if not any(
+            kw.arg in ("donate_argnums", "donate_argnames")
+            for kw in node.keywords
+        ):
+            continue
+        # the registry helper itself is the one sanctioned jit site
+        fn = ctx._parents.get(node)
+        while fn is not None and not isinstance(
+            fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            fn = ctx._parents.get(fn)
+        if fn is not None and fn.name == "_register_program":
+            continue
+        f = _finding(
+            ctx,
+            "SL007",
+            node,
+            "donated jax.jit outside the _programs registry "
+            "(_register_program) — invisible to graftcheck's "
+            "audit_programs",
+            "route the program through PagedServingEngine."
+            "_register_program so the registry records its raw fn, "
+            "donate_argnums and example avals for the GC002/GC003/GC006 "
+            "audits",
+        )
+        if f:
+            out.append(f)
+    return out
+
+
 _RULE_FNS = (
     _rule_sl001,
     _rule_sl002,
@@ -760,6 +808,7 @@ _RULE_FNS = (
     _rule_sl004,
     _rule_sl005,
     _rule_sl006,
+    _rule_sl007,
 )
 
 

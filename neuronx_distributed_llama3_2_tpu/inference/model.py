@@ -697,6 +697,24 @@ class LlamaDecode:
             return emitted, accept, new_tokens, new_positions, finite, cache
         return emitted, accept, new_tokens, new_positions, cache
 
+    def forbidden_gather_shapes(self, batch: int, kv_limit: int):
+        """The aval shapes a kernel-path decode/verify trace must never
+        contain: the materialized ``(b, kv_limit, NKV, D)`` gathered-KV
+        copy, plus its per-rank ``NKV/tp`` slice when a tp mesh is live.
+        This is the single source of truth behind graftcheck GC001 and
+        the no-gather jaxpr assertions (the gather fallback in
+        :meth:`_attend_paged` is exactly what materializes these)."""
+        from neuronx_distributed_llama3_2_tpu.parallel import (
+            state as parallel_state,
+        )
+
+        nkv, d = self.config.num_kv_heads, self.config.head_dim
+        shapes = {(batch, kv_limit, nkv, d)}
+        tp = parallel_state.tensor_parallel_size_or(1)
+        if tp > 1 and nkv % tp == 0:
+            shapes.add((batch, kv_limit, nkv // tp, d))
+        return shapes
+
     def _paged_kernel_eligible(self, t: int, tree) -> bool:
         """Gate for the Pallas paged-decode kernel: the ``use_paged_kernel``
         config opt-in, a *linear* fresh block of at most

@@ -76,6 +76,53 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def _aval_of(x):
+    """ShapeDtypeStruct twin of an array leaf (non-arrays pass through) —
+    what a :class:`ProgramRecord` remembers about its first dispatch so
+    the auditor can re-lower/retrace without holding live buffers."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x
+
+
+@dataclasses.dataclass
+class ProgramRecord:
+    """One compiled serving program plus the metadata graftcheck audits.
+
+    Every jitted program the engine dispatches lives in the ``_programs``
+    registry as one of these (``_register_program`` is the single
+    ``jax.jit`` site on the serving path — shardlint SL007 enforces
+    that). The record keeps the *raw* python callable and, after the
+    first dispatch, the example avals, so ``analysis.graftcheck`` can
+    retrace the jaxpr (GC001/GC003/GC004/GC005) and re-lower for the
+    donation-aliasing check (GC002) without touching live state.
+    """
+
+    key: tuple
+    kind: str                     # "pctx" | "psfx" | "pdecode" | ...
+    fn: Any                       # raw callable (pre-jit)
+    donate_argnums: tuple = ()
+    gather: bool = False          # kernel-shed (dense-gather) variant
+    checked: bool = False         # finite-verified variant
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    jitted: Any = None
+    example_args: Optional[tuple] = None  # avals of the first dispatch
+
+    def __call__(self, *args):
+        if self.example_args is None:
+            self.example_args = tuple(
+                jax.tree.map(_aval_of, a) for a in args
+            )
+        return self.jitted(*args)
+
+    def lower(self):
+        """Re-lower at the recorded example avals (trace-cache hit — the
+        program was already compiled at these avals)."""
+        if self.example_args is None:
+            raise ValueError(f"program {self.key!r} was never dispatched")
+        return self.jitted.lower(*self.example_args)
+
+
 @dataclasses.dataclass(frozen=True)
 class PagedConfig:
     """Knobs for the paged KV pool (see docs/serving.md)."""
@@ -387,31 +434,65 @@ class PagedServingEngine:
         self._last_readback_lag = 0  # dispatches between dispatch and read
         self._wait_ms = 0.0          # per-step readback wait scratch
         self._last_log_step = 0      # dedupe periodic metrics logging
-        self._programs: Dict[tuple, Any] = {}
+        self._programs: Dict[tuple, ProgramRecord] = {}
         if self._kv_quantized:
             # COW copies the block's scale tile with its payload — the scale
             # IS part of the block's value under quantized storage
-            self._copy_block_fn = jax.jit(
-                lambda c, s, d: type(c)(
+            def _copy_block(c, s, d):
+                return type(c)(
                     k=c.k.at[:, d].set(c.k[:, s]),
                     v=c.v.at[:, d].set(c.v[:, s]),
                     k_scale=c.k_scale.at[:, d].set(c.k_scale[:, s]),
                     v_scale=c.v_scale.at[:, d].set(c.v_scale[:, s]),
-                ),
-                donate_argnums=(0,),
-            )
+                )
         else:
-            self._copy_block_fn = jax.jit(
-                lambda c, s, d: type(c)(
+            def _copy_block(c, s, d):
+                return type(c)(
                     k=c.k.at[:, d].set(c.k[:, s]),
                     v=c.v.at[:, d].set(c.v[:, s]),
-                ),
-                donate_argnums=(0,),
-            )
+                )
+        self._copy_block_fn = self._register_program(
+            ("copy_block", self._kv_quantized), _copy_block,
+            donate_argnums=(0,), kind="copy_block",
+        )
         if precompile:
             self._warmup()
 
     # -- programs ----------------------------------------------------------
+
+    def _register_program(
+        self,
+        key_: tuple,
+        fn,
+        donate_argnums: tuple = (),
+        kind: Optional[str] = None,
+        gather: bool = False,
+        checked: bool = False,
+        **meta,
+    ) -> ProgramRecord:
+        """The single ``jax.jit`` site on the serving path: every program
+        the engine dispatches is wrapped in a :class:`ProgramRecord` and
+        cached in the ``_programs`` registry, so ``graftcheck``'s
+        ``audit_programs`` can see (and re-lower / retrace) the complete
+        compiled-program population. shardlint SL007 flags any donated
+        jit in ``serving/`` created anywhere else."""
+        rec = ProgramRecord(
+            key=key_,
+            kind=kind if kind is not None else str(key_[0]),
+            fn=fn,
+            donate_argnums=tuple(donate_argnums),
+            gather=gather,
+            checked=checked,
+            meta=meta,
+            jitted=jax.jit(fn, donate_argnums=donate_argnums),
+        )
+        self._programs[key_] = rec
+        return rec
+
+    def program_registry(self) -> Dict[tuple, ProgramRecord]:
+        """key -> :class:`ProgramRecord` for every program this engine has
+        built (the graftcheck audit surface; see ``audit_programs``)."""
+        return dict(self._programs)
 
     def _step_model(self):
         """The model instance new program traces bind: normally
@@ -456,8 +537,10 @@ class PagedServingEngine:
             logits = model._model()._logits(params, last)[:, 0, :]
             return sample(logits, key, cfg), cache
 
-        self._programs[key_] = jax.jit(fn, donate_argnums=(1,))
-        return self._programs[key_]
+        return self._register_program(
+            key_, fn, donate_argnums=(1,), kind="pctx",
+            gather=self._gather_shed(), bucket=bucket,
+        )
 
     def _prefill_suffix_program(
         self, bucket: int, kv_limit: int, cfg: SamplingConfig
@@ -483,8 +566,10 @@ class PagedServingEngine:
             logits = model._model()._logits(params, last)[:, 0, :]
             return sample(logits, key, cfg), cache
 
-        self._programs[key_] = jax.jit(fn, donate_argnums=(1,))
-        return self._programs[key_]
+        return self._register_program(
+            key_, fn, donate_argnums=(1,), kind="psfx",
+            gather=self._gather_shed(), bucket=bucket, kv_limit=kv_limit,
+        )
 
     def _decode_program(self, cfg: SamplingConfig, kv_limit: int):
         """Resident-state decode: one T=1 step over the device-resident
@@ -525,8 +610,10 @@ class PagedServingEngine:
                 )
                 return sample(logits, key, cfg), new_positions, cache
 
-        self._programs[key_] = jax.jit(fn, donate_argnums=(1, 3))
-        return self._programs[key_]
+        return self._register_program(
+            key_, fn, donate_argnums=(1, 3), kind="pdecode",
+            gather=self._gather_shed(), checked=checked, kv_limit=kv_limit,
+        )
 
     def _verify_program(self, kv_limit: int, k: int):
         """Speculative verify: score the per-lane candidate block
@@ -564,8 +651,11 @@ class PagedServingEngine:
                     kv_limit=kv_limit, pos_cap=pos_cap,
                 )
 
-        self._programs[key_] = jax.jit(fn, donate_argnums=(1, 3))
-        return self._programs[key_]
+        return self._register_program(
+            key_, fn, donate_argnums=(1, 3), kind="pverify",
+            gather=self._gather_shed(), checked=checked,
+            kv_limit=kv_limit, k=k,
+        )
 
     def _lane_set_program(self):
         """Full-lane resident-state update: scatter one lane's (token,
@@ -585,8 +675,9 @@ class PagedServingEngine:
                 tables.at[lane].set(trow),
             )
 
-        self._programs[key_] = jax.jit(fn, donate_argnums=(0, 1, 2))
-        return self._programs[key_]
+        return self._register_program(
+            key_, fn, donate_argnums=(0, 1, 2), kind="lane_set"
+        )
 
     def _table_delta_program(self):
         """Single-entry block-table scatter: decode growth appends one
@@ -600,8 +691,9 @@ class PagedServingEngine:
         def fn(tables, lane, col, val):
             return tables.at[lane, col].set(val)
 
-        self._programs[key_] = jax.jit(fn, donate_argnums=(0,))
-        return self._programs[key_]
+        return self._register_program(
+            key_, fn, donate_argnums=(0,), kind="table_delta"
+        )
 
     # -- host<->device choke points ---------------------------------------
 

@@ -1,0 +1,325 @@
+#!/usr/bin/env python
+"""graftcheck CI gate: trace the serving engine's representative programs
+and enforce the GC001-GC006 program-level rules.
+
+Usage:
+    python scripts/graftcheck_gate.py                   # run the catalog
+    python scripts/graftcheck_gate.py --list            # list catalog entries
+    python scripts/graftcheck_gate.py --rules           # print the catalogue
+    python scripts/graftcheck_gate.py --write-baseline
+
+Where shardlint_gate.py lints source ASTs, this gate lints *programs*: it
+builds tiny CPU-hosted serving engines, runs a few requests so the real
+program registry populates, audits it (``analysis.graftcheck.
+audit_programs`` — donation aliasing, host-transfer census, collective
+audit, registry purity), and direct-traces the decode/verify/tp=2/int8
+variants for the shape- and dtype-level rules. Exit status is nonzero iff
+a finding is NOT in the baseline file. Baselining is an explicit,
+reviewed act: run with ``--write-baseline`` and commit with a rationale.
+
+The tier-1 suite runs this gate as
+``tests/test_graftcheck.py::test_self_audit`` — no separate CI plumbing.
+
+Registering a new traced program: add a ``(name, fn)`` entry to
+``CATALOG`` below returning a finding list (use the ``check_*`` helpers,
+or build an engine and return ``audit_programs(engine)``); per-entry rule
+opt-outs go through the helpers' ``suppress=`` argument, accepted
+findings through the baseline file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# CPU-hosted like tests/conftest.py: 8 virtual devices (the tp=2 catalog
+# entries slice the first two), set before jax initializes its backend.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)
+# own persistent compile cache so repeat gate runs skip XLA (the engine
+# entries are the only ones that compile). Deliberately NOT the test
+# suite's tests/.jax_cache: the gate runs as a subprocess inside tier-1,
+# and two processes hitting one cache dir concurrently has produced
+# corrupt entries (wrong executables, nondeterministic parity failures)
+_CACHE = os.path.join(REPO_ROOT, "tests", ".jax_cache_graftcheck")
+try:
+    jax.config.update("jax_compilation_cache_dir", _CACHE)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+except Exception:
+    pass
+
+from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import (  # noqa: E402
+    GC_RULES,
+    audit_programs,
+    check_collectives,
+    check_fp32_widening,
+    check_host_transfers,
+    check_no_gather,
+    filter_baseline,
+    read_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(
+    REPO_ROOT, "scripts", "graftcheck_baseline.txt"
+)
+
+_TINY = None
+_PARAMS = None
+
+
+def _tiny():
+    """(kernel config, params) — shared across catalog entries."""
+    global _TINY, _PARAMS
+    if _TINY is None:
+        import dataclasses
+
+        from neuronx_distributed_llama3_2_tpu.models.llama import (
+            LLAMA_CONFIGS,
+            LlamaForCausalLM,
+        )
+
+        _TINY = dataclasses.replace(
+            LLAMA_CONFIGS["tiny"], use_paged_kernel=True
+        )
+        _PARAMS = LlamaForCausalLM(_TINY).init(jax.random.key(0))
+    return _TINY, _PARAMS
+
+
+def _engine(kv_cache_dtype="bf16", spec=0):
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    cfg, params = _tiny()
+    kw = dict(block_size=8, num_blocks=32, kv_cache_dtype=kv_cache_dtype)
+    if spec:
+        kw["spec_draft_tokens"] = spec
+    return PagedServingEngine(
+        InferenceEngine(
+            cfg, params, max_batch=4, max_seq_len=64, buckets=[8, 16]
+        ),
+        GenerationConfig(max_new_tokens=6),
+        PagedConfig(**kw),
+        precompile=False,
+    )
+
+
+def _run_and_audit(engine):
+    """Drive a couple of short requests through the engine so the real
+    program registry populates (prefill, decode, verify, lane_set,
+    table_delta scatters), then audit it."""
+    rng = np.random.default_rng(0)
+    cfg, _ = _tiny()
+    for n in (5, 7):
+        engine.submit(rng.integers(0, cfg.vocab_size, size=(n,)).tolist())
+    engine.run_to_completion()
+    return audit_programs(engine)
+
+
+def _decode_trace(model, params, b=4, kv_limit=32, nb=16, bs=8, w=8):
+    cache = model.init_paged_cache(nb, bs)
+    return jax.make_jaxpr(
+        lambda p, c, t, ps, tb: model.decode_step(
+            p, c, t, ps, tb, kv_limit=kv_limit, pos_cap=63
+        )
+    )(
+        params, cache, jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b, w), jnp.int32),
+    )
+
+
+def _verify_trace(model, params, k, b=4, kv_limit=32, nb=16, bs=8, w=8):
+    cache = model.init_paged_cache(nb, bs)
+    return jax.make_jaxpr(
+        lambda p, c, t, ps, tb, dl: model.verify_step(
+            p, c, t, ps, tb, dl, kv_limit=kv_limit, pos_cap=63
+        )
+    )(
+        params, cache, jnp.zeros((b, k + 1), jnp.int32),
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b, w), jnp.int32),
+        jnp.zeros((b,), jnp.int32),
+    )
+
+
+def _trace_rules(closed, name, model, b=4, kv_limit=32, quantized=False):
+    out = []
+    out.extend(
+        check_no_gather(
+            closed, model.forbidden_gather_shapes(b, kv_limit), name
+        )
+    )
+    out.extend(check_host_transfers(closed, name))
+    out.extend(check_collectives(closed, name))
+    if quantized:
+        out.extend(check_fp32_widening(closed, name))
+    return out
+
+
+def entry_engine():
+    """Spec-enabled int8 kernel engine: full registry audit — GC001-GC006
+    over pctx/pdecode/pverify and the lane_set/table_delta scatters as
+    actually compiled, GC005 over every program since the pool is
+    quantized. (bf16 engines get the same audit in every serving-suite
+    teardown; the gate runs the strictest single configuration.)"""
+    return _run_and_audit(_engine(kv_cache_dtype="int8", spec=4))
+
+
+def entry_decode():
+    """decode t=1 kernel trace (tp=1)."""
+    from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
+
+    cfg, params = _tiny()
+    model = LlamaDecode(cfg)
+    return _trace_rules(_decode_trace(model, params), "decode", model)
+
+
+def entry_decode_int8():
+    """decode t=1 trace over the int8 pool: GC005 on the dequant path."""
+    from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
+
+    cfg, params = _tiny()
+    model = LlamaDecode(cfg)
+    cache = model.init_paged_cache(16, 8, kv_cache_dtype="int8")
+    closed = jax.make_jaxpr(
+        lambda p, c, t, ps, tb: model.decode_step(
+            p, c, t, ps, tb, kv_limit=32, pos_cap=63
+        )
+    )(
+        params, cache, jnp.zeros((4,), jnp.int32),
+        jnp.zeros((4,), jnp.int32), jnp.zeros((4, 8), jnp.int32),
+    )
+    return _trace_rules(closed, "decode-int8", model, quantized=True)
+
+
+def entry_verify_t1():
+    """verify t=1 (k=1 draft) kernel trace."""
+    from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
+
+    cfg, params = _tiny()
+    model = LlamaDecode(cfg)
+    return _trace_rules(_verify_trace(model, params, k=1), "verify-t1", model)
+
+
+def entry_verify_t4():
+    """verify t=4 (k=4 draft block) kernel trace."""
+    from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
+
+    cfg, params = _tiny()
+    model = LlamaDecode(cfg)
+    return _trace_rules(_verify_trace(model, params, k=4), "verify-t4", model)
+
+
+def entry_decode_tp2():
+    """decode t=1 trace under a pure-tp=2 mesh: GC001 at full NKV *and*
+    the per-rank NKV/2 slice, GC004 over the kernel's shard_map region."""
+    from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
+    from neuronx_distributed_llama3_2_tpu.parallel.state import (
+        destroy_model_parallel,
+        initialize_model_parallel,
+    )
+
+    cfg, params = _tiny()
+    initialize_model_parallel(
+        tensor_model_parallel_size=2, devices=jax.devices()[:2]
+    )
+    try:
+        model = LlamaDecode(cfg)
+        return _trace_rules(
+            _decode_trace(model, params), "decode-tp2", model
+        )
+    finally:
+        destroy_model_parallel()
+
+
+# the program catalog: (name, thunk) -> findings. The engine entry runs
+# first (it must run while no mesh is live); the tp entry manages its own
+# mesh.
+CATALOG = (
+    ("engine-int8-spec", entry_engine),
+    ("decode", entry_decode),
+    ("decode-int8", entry_decode_int8),
+    ("verify-t1", entry_verify_t1),
+    ("verify-t4", entry_verify_t4),
+    ("decode-tp2", entry_decode_tp2),
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline to accept all current findings",
+    )
+    ap.add_argument(
+        "--rules", action="store_true", help="print the rule catalogue"
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="list program-catalog entries"
+    )
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rule, summary in sorted(GC_RULES.items()):
+            print(f"{rule}  {summary}")
+        return 0
+    if args.list:
+        for name, fn in CATALOG:
+            print(f"{name}  {(fn.__doc__ or '').splitlines()[0]}")
+        return 0
+
+    findings = []
+    for name, fn in CATALOG:
+        got = fn()
+        print(f"graftcheck: {name}: {len(got)} finding(s)")
+        findings.extend(got)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = read_baseline(args.baseline)
+    new = filter_baseline(findings, baseline)
+    old = len(findings) - len(new)
+
+    for f in new:
+        print(f.format())
+    if old:
+        print(f"{old} baselined finding(s) suppressed ({args.baseline})")
+    if new:
+        print(
+            f"graftcheck: {len(new)} new finding(s). Fix them, suppress the "
+            "rule for that program in the catalog entry, or baseline with "
+            "--write-baseline and a commit rationale."
+        )
+        return 1
+    print(f"graftcheck: clean ({len(findings)} total, {old} baselined)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

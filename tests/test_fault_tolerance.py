@@ -23,6 +23,7 @@ import jax
 import numpy as np
 import pytest
 
+from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import audit_programs
 from neuronx_distributed_llama3_2_tpu.inference import (
     GenerationConfig,
     InferenceEngine,
@@ -116,6 +117,7 @@ def _assert_clean_pool(paged):
     assert paged.allocator.active_blocks == 0
     assert paged.allocator.leak_check() == []
     assert audit_engine(paged) == []
+    assert audit_programs(paged) == []
 
 
 def _assert_survivor_parity(paged, baseline):

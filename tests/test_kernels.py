@@ -125,6 +125,7 @@ def test_pallas_segments_backward():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
+@pytest.mark.slow  # tier-1 time budget; cheaper siblings cover this path
 def test_chunked_ce_matches_full():
     model = LlamaForCausalLM(TINY)
     params = model.init(jax.random.key(0))

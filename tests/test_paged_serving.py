@@ -21,6 +21,10 @@ from neuronx_distributed_llama3_2_tpu.models.llama import (
     LLAMA_CONFIGS,
     LlamaForCausalLM,
 )
+from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import (
+    all_shapes,
+    audit_programs,
+)
 from neuronx_distributed_llama3_2_tpu.serving import (
     PagedConfig,
     PagedServingEngine,
@@ -71,6 +75,7 @@ def test_paged_matches_dense_on_mixed_length_batch(params):
     assert paged.allocator.active_blocks == 0  # everything released
     assert paged.allocator.leak_check() == []
     assert audit_engine(paged) == []
+    assert audit_programs(paged) == []
 
 
 def test_prefix_reuse_reports_cached_tokens_and_stays_equivalent(params):
@@ -148,6 +153,7 @@ def test_copy_on_write_on_partial_block_share(params):
     assert {0: out1[0], 1: out2[1]} == dense
 
 
+@pytest.mark.slow  # tier-1 time budget; prefix reuse covered by the cached-tokens test
 def test_acceptance_prefix_workload():
     # the ISSUE acceptance bar, via the bench entry point: 16 requests
     # sharing a 256-token prefix -> >=50% of prefill tokens skipped AND
@@ -304,21 +310,6 @@ def test_paged_kernel_decode_never_materializes_gather(params):
     from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
 
     b, kv_limit, nb, bs, w = 4, 32, 16, 8, 8
-
-    def all_shapes(jaxpr, acc):
-        for eqn in jaxpr.eqns:
-            for v in list(eqn.invars) + list(eqn.outvars):
-                aval = getattr(v, "aval", None)
-                if aval is not None and hasattr(aval, "shape"):
-                    acc.add(tuple(aval.shape))
-            for p in eqn.params.values():
-                for x in (p if isinstance(p, (list, tuple)) else [p]):
-                    if hasattr(x, "jaxpr"):       # ClosedJaxpr
-                        all_shapes(x.jaxpr, acc)
-                    elif hasattr(x, "eqns"):      # raw Jaxpr
-                        all_shapes(x, acc)
-        return acc
-
     forbidden = (b, kv_limit, TINY.num_kv_heads, TINY.head_dim)
     for flag, expect_gather in ((False, True), (True, False)):
         cfg = dataclasses.replace(TINY, use_paged_kernel=flag)
@@ -332,7 +323,7 @@ def test_paged_kernel_decode_never_materializes_gather(params):
             params, cache, jnp.zeros((b, 1), jnp.int32),
             jnp.zeros((b,), jnp.int32), jnp.zeros((b, w), jnp.int32),
         )
-        shapes = all_shapes(closed.jaxpr, set())
+        shapes = all_shapes(closed)
         assert (forbidden in shapes) is expect_gather, (
             f"use_paged_kernel={flag}: gather aval {forbidden} "
             f"{'missing' if expect_gather else 'present'} in decode jaxpr"

@@ -773,6 +773,7 @@ def test_interleaved_1f1b_plan_invariants(M, V, pp):
     assert len(fdone) == total and len(bdone) == total
 
 
+@pytest.mark.slow  # tier-1 time budget; cheaper siblings cover this path
 def test_interleaved_memory_bounded_backward_matches_dense():
     """The Interleaved1F1BPlan executor reproduces dense loss AND gradients
     exactly (fp32, CPU mesh), with the autodiff interleave as a second

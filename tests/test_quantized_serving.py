@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import audit_programs
 from neuronx_distributed_llama3_2_tpu.inference import (
     GenerationConfig,
     InferenceEngine,
@@ -180,7 +181,11 @@ def test_quantized_parity_matrix(params, int8_baseline, model_cfg, async_loop, c
     assert paged.metrics.snapshot()["kv_dtype"] == "int8"
 
 
-@pytest.mark.parametrize("kv_dtype", ["fp8_e4m3", "fp8_e5m2"])
+@pytest.mark.parametrize(
+    "kv_dtype",
+    # tier-1 time budget: one fp8 flavour in the default tier, the other slow
+    ["fp8_e4m3", pytest.param("fp8_e5m2", marks=pytest.mark.slow)],
+)
 def test_fp8_gather_matches_kernel(params, kv_dtype):
     gen = GenerationConfig(max_new_tokens=6)
     prompts = _prompts(np.random.default_rng(11), (5, 12, 9))
@@ -264,6 +269,7 @@ def test_cow_prefix_share_stays_exact(params):
 # -- speculative decoding drift canary -------------------------------------
 
 
+@pytest.mark.slow  # tier-1 time budget; statistical canary, not a parity gate
 def test_spec_accept_rate_drift_canary(params):
     """Soak canary: the n-gram drafter's accept rate under int8 must track
     the fp rate — quantization error that flipped verify argmaxes would
@@ -319,6 +325,7 @@ def test_quantized_steady_state_is_fully_resident(params):
     # quantized teardown: pool drained, scale arrays still matching dtype
     assert paged.allocator.leak_check() == []
     assert audit_engine(paged) == []
+    assert audit_programs(paged) == []
 
 
 # -- tensor parallel -------------------------------------------------------

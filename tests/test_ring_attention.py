@@ -277,6 +277,7 @@ def test_zigzag_permutation_roundtrip():
     )
 
 
+@pytest.mark.slow  # tier-1 time budget; cheaper siblings cover this path
 def test_model_level_zigzag_matches_contiguous():
     """cp_ring_layout='zigzag': the backbone permutes ONCE outside the layer
     stack (no per-attention-call shuffles), declares the layout via
@@ -320,6 +321,7 @@ def test_model_level_zigzag_matches_contiguous():
     parallel_state.destroy_model_parallel()
 
 
+@pytest.mark.slow  # tier-1 time budget; cheaper siblings cover this path
 def test_gpipe_cp_zigzag_trains():
     """pp=2 x cp=2 gpipe with forced zigzag: the pipeline executor permutes
     once, the per-layer ring runs pre-permuted, loss finite and equal to

@@ -399,6 +399,70 @@ def test_sl006_bound_axis_and_unknown_axis_names_ok():
     assert fs == []
 
 
+# ---------------------------------------------------------------- SL007
+
+
+def test_sl007_adhoc_donated_jit_in_serving_fires():
+    src = """
+    import jax
+
+    class Engine:
+        def __init__(self):
+            self._fn = jax.jit(lambda c: c, donate_argnums=(0,))
+    """
+    fs = lint_source(
+        textwrap.dedent(src),
+        path="neuronx_distributed_llama3_2_tpu/serving/engine.py",
+    )
+    fs = [f for f in fs if f.rule == "SL007"]
+    assert len(fs) == 1
+    assert "_register_program" in fs[0].message + fs[0].hint
+
+
+def test_sl007_registry_helper_and_other_layers_quiet():
+    src = """
+    import jax
+
+    class Engine:
+        def _register_program(self, key_, fn, donate_argnums=()):
+            rec = jax.jit(fn, donate_argnums=donate_argnums)
+            self._programs[key_] = rec
+            return rec
+
+        def _plain(self, fn):
+            return jax.jit(fn)  # undonated: not a registry concern
+    """
+    fs = lint_source(
+        textwrap.dedent(src),
+        path="neuronx_distributed_llama3_2_tpu/serving/engine.py",
+    )
+    assert [f for f in fs if f.rule == "SL007"] == []
+    # same donated jit OUTSIDE serving/: a different layer's business
+    outside = """
+    import jax
+
+    many = jax.jit(lambda c: c, donate_argnums=(0,))
+    """
+    fs = lint_source(
+        textwrap.dedent(outside),
+        path="neuronx_distributed_llama3_2_tpu/inference/runner.py",
+    )
+    assert [f for f in fs if f.rule == "SL007"] == []
+
+
+def test_sl007_donate_argnames_spelling_fires():
+    src = """
+    from jax import jit
+
+    step = jit(lambda c: c, donate_argnames=("cache",))
+    """
+    fs = lint_source(
+        textwrap.dedent(src),
+        path="neuronx_distributed_llama3_2_tpu/serving/scheduler.py",
+    )
+    assert [f.rule for f in fs if f.rule == "SL007"] == ["SL007"]
+
+
 # ----------------------------------------------------------- machinery
 
 
@@ -437,7 +501,7 @@ def test_load_axis_env_matches_state_py():
 
 def test_rule_catalogue_complete():
     assert sorted(RULES) == [
-        "SL001", "SL002", "SL003", "SL004", "SL005", "SL006",
+        "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
     ]
 
 

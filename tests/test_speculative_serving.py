@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import audit_programs
 from neuronx_distributed_llama3_2_tpu.inference import (
     GenerationConfig,
     InferenceEngine,
@@ -74,6 +75,7 @@ def _run(paged, prompts):
     assert paged.allocator.active_blocks == 0
     assert paged.allocator.leak_check() == []
     assert audit_engine(paged) == []
+    assert audit_programs(paged) == []
     return out
 
 
@@ -269,22 +271,9 @@ def test_verify_step_program_contains_no_gather(params):
     """Acceptance: the multi-token verify jaxpr must not materialize the
     (b, kv_limit, NKV, D) block-table gather when the kernel is on — and
     must when it is off (the walker actually detects it)."""
+    from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import all_shapes
+
     b, k, kv_limit, nb, bs, w = 4, 4, 32, 16, 8, 8
-
-    def all_shapes(jaxpr, acc):
-        for eqn in jaxpr.eqns:
-            for v in list(eqn.invars) + list(eqn.outvars):
-                aval = getattr(v, "aval", None)
-                if aval is not None and hasattr(aval, "shape"):
-                    acc.add(tuple(aval.shape))
-            for p in eqn.params.values():
-                for x in (p if isinstance(p, (list, tuple)) else [p]):
-                    if hasattr(x, "jaxpr"):
-                        all_shapes(x.jaxpr, acc)
-                    elif hasattr(x, "eqns"):
-                        all_shapes(x, acc)
-        return acc
-
     forbidden = (b, kv_limit, TINY.num_kv_heads, TINY.head_dim)
     for flag, expect_gather in ((False, True), (True, False)):
         cfg = dataclasses.replace(TINY, use_paged_kernel=flag)
@@ -299,7 +288,7 @@ def test_verify_step_program_contains_no_gather(params):
             jnp.zeros((b,), jnp.int32), jnp.zeros((b, w), jnp.int32),
             jnp.zeros((b,), jnp.int32),
         )
-        shapes = all_shapes(closed.jaxpr, set())
+        shapes = all_shapes(closed)
         assert (forbidden in shapes) is expect_gather, (
             f"use_paged_kernel={flag}: gather aval {forbidden} "
             f"{'missing' if expect_gather else 'present'} in verify jaxpr"

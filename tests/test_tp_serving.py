@@ -184,10 +184,27 @@ def matrix_ref(params):
     return prompts, _dense_outputs(params, prompts, MATRIX_GEN)
 
 
-@pytest.mark.parametrize("spec", [0, 3], ids=["plain", "spec"])
-@pytest.mark.parametrize("async_loop", [False, True], ids=["sync", "async"])
-@pytest.mark.parametrize("chunk", [None, 6], ids=["whole", "chunked"])
-def test_tp2_engine_parity_matrix(params, matrix_ref, spec, async_loop, chunk):
+# tier-1 time budget: the default tier runs a pairwise-covering quartet
+# (every chunk/async/spec value appears with every other value at least
+# once); the other half of the cube rides in the slow tier.
+@pytest.mark.parametrize(
+    "chunk,async_loop,spec",
+    [
+        pytest.param(6, True, 3, id="chunked-async-spec"),
+        pytest.param(6, False, 0, id="chunked-sync-plain"),
+        pytest.param(None, True, 0, id="whole-async-plain"),
+        pytest.param(None, False, 3, id="whole-sync-spec"),
+        pytest.param(6, False, 3, id="chunked-sync-spec",
+                     marks=pytest.mark.slow),
+        pytest.param(6, True, 0, id="chunked-async-plain",
+                     marks=pytest.mark.slow),
+        pytest.param(None, True, 3, id="whole-async-spec",
+                     marks=pytest.mark.slow),
+        pytest.param(None, False, 0, id="whole-sync-plain",
+                     marks=pytest.mark.slow),
+    ],
+)
+def test_tp2_engine_parity_matrix(params, matrix_ref, chunk, async_loop, spec):
     """Greedy outputs identical: tp=2 engine == tp=1 engine == dense engine,
     across speculative × async-loop × chunked-prefill, with the Pallas
     kernel eligible (no dense-gather fallback) on both sides."""
@@ -247,23 +264,10 @@ def test_tp2_decode_jaxpr_has_no_gather(params):
     materialize the (b, kv_limit, NKV, D) gathered K/V copy — neither at
     full NKV nor at the per-rank NKV/tp slice — while the gather-path
     jaxpr (use_paged_kernel off) does contain its sharded gather."""
+    from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import all_shapes
     from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
 
     b, kv_limit, nb, bs, w = 4, 32, 16, 8, 8
-
-    def all_shapes(jaxpr, acc):
-        for eqn in jaxpr.eqns:
-            for v in list(eqn.invars) + list(eqn.outvars):
-                aval = getattr(v, "aval", None)
-                if aval is not None and hasattr(aval, "shape"):
-                    acc.add(tuple(aval.shape))
-            for p in eqn.params.values():
-                for x in (p if isinstance(p, (list, tuple)) else [p]):
-                    if hasattr(x, "jaxpr"):       # ClosedJaxpr
-                        all_shapes(x.jaxpr, acc)
-                    elif hasattr(x, "eqns"):      # raw Jaxpr
-                        all_shapes(x, acc)
-        return acc
 
     _tp_mesh()
     nkv = TINY.num_kv_heads
@@ -283,7 +287,7 @@ def test_tp2_decode_jaxpr_has_no_gather(params):
             params, cache, jnp.zeros((b, 1), jnp.int32),
             jnp.zeros((b,), jnp.int32), jnp.zeros((b, w), jnp.int32),
         )
-        shapes = all_shapes(closed.jaxpr, set())
+        shapes = all_shapes(closed)
         hit = bool(forbidden & shapes)
         assert hit is expect_gather, (
             f"use_paged_kernel={flag}: gather aval "
