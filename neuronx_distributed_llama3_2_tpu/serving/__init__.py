@@ -29,13 +29,19 @@ from neuronx_distributed_llama3_2_tpu.serving.faults import (
     FaultPlan,
     InjectedFault,
 )
+from neuronx_distributed_llama3_2_tpu.serving.histogram import Histogram
 from neuronx_distributed_llama3_2_tpu.serving.invariants import (
     InvariantViolation,
     audit_engine,
+    summarize_violations,
 )
 from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
     RadixPrefixIndex,
+)
+from neuronx_distributed_llama3_2_tpu.serving.tracing import (
+    EngineTracer,
+    program_label,
 )
 
 __all__ = [
@@ -45,8 +51,10 @@ __all__ = [
     "BlockAllocator",
     "DraftProposer",
     "EngineStalledError",
+    "EngineTracer",
     "FaultInjector",
     "FaultPlan",
+    "Histogram",
     "InjectedFault",
     "InvariantViolation",
     "NGramDrafter",
@@ -56,4 +64,6 @@ __all__ = [
     "ServingMetrics",
     "audit_engine",
     "make_serving_engine",
+    "program_label",
+    "summarize_violations",
 ]

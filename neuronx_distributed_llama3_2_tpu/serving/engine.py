@@ -67,6 +67,10 @@ from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
     RadixPrefixIndex,
 )
+from neuronx_distributed_llama3_2_tpu.serving.tracing import (
+    EngineTracer,
+    program_label,
+)
 from neuronx_distributed_llama3_2_tpu.utils.logger import get_logger
 
 logger = get_logger()
@@ -206,6 +210,20 @@ class PagedConfig:
     degrade_after_faults: int = 0
     degrade_window_steps: int = 64
     degrade_recover_steps: int = 64
+    # -- observability (docs/serving.md "Observability") --
+    # graftscope flight recorder: record one structured event per engine
+    # phase (admit wave, prefill chunk, decode/verify dispatch tagged with
+    # its ProgramRecord key, readback, lane/table flushes, fault and
+    # ladder instants) into a per-step ring buffer, exportable as Chrome
+    # trace-event JSON via engine.export_trace(path). Pure host-side
+    # python around the existing funnels: no uploads, no syncs, no new
+    # program keys (graftcheck GC003/GC006 hold with tracing on). Request
+    # timestamps and the latency histograms are metrics, not tracing —
+    # they stay on regardless of this flag.
+    trace_enabled: bool = False
+    # ring-buffer capacity of the flight recorder: only the last N steps
+    # are retained, so trace memory is bounded however long the engine runs
+    trace_buffer_steps: int = 256
 
 
 @dataclasses.dataclass
@@ -238,6 +256,15 @@ class _PagedRequest:
     # the request is done with partial output and `error` holds the detail
     failed: bool = False
     error: Optional[str] = None
+    # lifecycle timestamps (time.perf_counter seconds, always recorded):
+    # request_info derives queue_ms/ttft_ms/tpot_ms from these, and they
+    # survive into the terminal record (finished AND failed requests keep
+    # their timing context)
+    submitted_at: float = 0.0
+    admitted_at: Optional[float] = None    # first admission only
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    prefill_ms: float = 0.0                # cumulative across re-admissions
 
 
 class PagedServingEngine:
@@ -345,6 +372,17 @@ class PagedServingEngine:
         self.allocator = BlockAllocator(paged.num_blocks, bs)
         self.index = RadixPrefixIndex(self.allocator)
         self.metrics = ServingMetrics()
+        # graftscope flight recorder (serving/tracing.py): always
+        # constructed — every hook is a no-op attribute test when
+        # trace_enabled is off, so the fault-free/trace-free path pays
+        # nothing and the traced path touches no device state
+        self.tracer = EngineTracer(
+            enabled=paged.trace_enabled,
+            buffer_steps=paged.trace_buffer_steps or 256,
+        )
+        if injector is not None:
+            # fault firings become trace instants at the moment they fire
+            injector.on_fire = self._trace_fault
         # checked (finite-verified) program variants: separate _programs
         # keys whose decode/verify traces add a (B,) poison-mask input and a
         # (B,) `finite` output; selected by the knob or implied by a chaos
@@ -714,7 +752,10 @@ class PagedServingEngine:
             self.injector.maybe_latency("read")
         t0 = time.perf_counter()
         arr = read_host_tokens(toks)
-        self._wait_ms += (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        self._wait_ms += (t1 - t0) * 1e3
+        if self.tracer.enabled:
+            self.tracer.complete("readback", t0, t1, n=int(arr.size))
         return arr
 
     # -- fault handling (docs/serving.md "Failure handling & degradation") --
@@ -785,6 +826,11 @@ class PagedServingEngine:
             req.lane = None
         self._finished[req.rid] = req
         self.metrics.failed_requests += 1
+        self._note_terminal(req)
+        self.tracer.instant(
+            "request_failed", rid=req.rid, error=req.error[:160]
+        )
+        self.tracer.request_state(req.rid, "failed")
         self._note_event()
         logger.warning(
             "request %d failed after %d tokens: %s",
@@ -819,6 +865,35 @@ class PagedServingEngine:
             self._note_event()  # _fail_request notes it otherwise
         return bool(self._active or self._queue)
 
+    def _trace_fault(self, step: int, kind: str, site: str, lanes) -> None:
+        """FaultInjector.on_fire callback: every chaos firing lands in the
+        flight recorder as an instant at the moment it fires."""
+        self.tracer.instant(
+            "fault", kind=kind, site=site, lanes=list(lanes)
+        )
+
+    def _note_first_token(self, req: _PagedRequest) -> None:
+        """First sampled token for this request (always the final prefill
+        chunk of its first admission): stamp TTFT."""
+        if req.first_token_at is None:
+            req.first_token_at = time.perf_counter()
+            self.metrics.hist_ttft_ms.observe(
+                (req.first_token_at - req.submitted_at) * 1e3
+            )
+
+    def _note_terminal(self, req: _PagedRequest) -> None:
+        """Terminal transition (finished or failed): stamp the end time and
+        fold the request's mean inter-token latency into the TPOT
+        histogram (needs >= 2 tokens to define an interval)."""
+        if req.finished_at is not None:
+            return
+        req.finished_at = time.perf_counter()
+        if req.first_token_at is not None and len(req.out) > 1:
+            self.metrics.hist_tpot_ms.observe(
+                (req.finished_at - req.first_token_at) * 1e3
+                / (len(req.out) - 1)
+            )
+
     def _note_event(self) -> None:
         """Record one fault/pressure event for the degradation ladder."""
         self._last_event_step = self._step_index
@@ -848,6 +923,10 @@ class PagedServingEngine:
                     "degradation ladder: climbing to level %d",
                     self._degrade_level,
                 )
+                self.tracer.instant(
+                    "degradation", level=self._degrade_level,
+                    direction="climb",
+                )
             if self._degrade_level >= 4 and len(self._active) > 1:
                 self._drain_pending()
                 victim = max(self._active.values(), key=lambda r: r.rid)
@@ -863,6 +942,10 @@ class PagedServingEngine:
             self._last_event_step = self._step_index
             logger.info(
                 "degradation ladder: recovered to level %d", self._degrade_level
+            )
+            self.tracer.instant(
+                "degradation", level=self._degrade_level,
+                direction="recover",
             )
 
     def _progress_sig(self) -> tuple:
@@ -910,6 +993,14 @@ class PagedServingEngine:
         if violations:
             self.metrics.audit_violations += len(violations)
             logger.error("serving invariant violations: %s", violations)
+            from neuronx_distributed_llama3_2_tpu.serving.invariants import (
+                summarize_violations,
+            )
+
+            self.tracer.instant(
+                "invariant_violation", count=len(violations),
+                detail=summarize_violations(violations),
+            )
             if strict:
                 raise InvariantViolation(violations)
         return violations
@@ -969,13 +1060,36 @@ class PagedServingEngine:
             )
         rid = self._next_rid
         self._next_rid += 1
-        req = _PagedRequest(rid=rid, prompt=list(prompt), out=[])
+        req = _PagedRequest(
+            rid=rid, prompt=list(prompt), out=[],
+            submitted_at=time.perf_counter(),
+        )
         self._queue.append(req)
         self._requests[rid] = req
         self.metrics.submitted += 1
+        self.tracer.request_state(rid, "queued")
         return rid
 
     def _admit(self) -> None:
+        """Admission wave, wrapped in one flight-recorder slice when there
+        is anything to admit (the traced span covers every prefill the
+        wave runs inline)."""
+        if not (self._queue and self._free_lanes):
+            return
+        tr = self.tracer
+        if not tr.enabled:
+            return self._admit_wave()
+        before = self.metrics.admitted
+        t0 = tr.now()
+        try:
+            self._admit_wave()
+        finally:
+            tr.complete(
+                "admit", t0, waiting=len(self._queue),
+                admitted=self.metrics.admitted - before,
+            )
+
+    def _admit_wave(self) -> None:
         bs = self.paged.block_size
         alloc = self.allocator
         while self._queue and self._free_lanes:
@@ -1037,6 +1151,9 @@ class PagedServingEngine:
             self._active[lane] = req
             self.metrics.admitted += 1
             self.metrics.cached_tokens += cached
+            if req.admitted_at is None:  # queue_ms = first admission wait
+                req.admitted_at = time.perf_counter()
+            self.tracer.request_state(req.rid, "prefilling")
             chunk = self.paged.prefill_chunk_tokens
             if chunk and len(seq) - cached > chunk:
                 # chunked admission: the lane holds its blocks but joins the
@@ -1056,6 +1173,7 @@ class PagedServingEngine:
                 continue
             suffix = seq[cached:]
             self._key, k = jax.random.split(self._key)
+            t_p = time.perf_counter()
             try:
                 self._chaos_device("prefill", (lane,))
                 first = self._prefill(suffix, cached, table, k)
@@ -1064,8 +1182,17 @@ class PagedServingEngine:
                 # lane/table teardown leaves the admission wave consistent
                 self._fail_request(req, str(fault))
                 continue
+            t_p1 = time.perf_counter()
+            req.prefill_ms += (t_p1 - t_p) * 1e3
+            if self.tracer.enabled:
+                self.tracer.complete(
+                    "prefill", t_p, t_p1, rid=req.rid,
+                    tokens=len(suffix), cached=cached,
+                )
             req.out.append(first)
             req.position = len(seq)
+            self._note_first_token(req)
+            self.tracer.request_state(req.rid, "active")
             self._tokens[lane] = first
             self._positions[lane] = req.position
             self._tables[lane, : len(table)] = table
@@ -1138,6 +1265,7 @@ class PagedServingEngine:
                 tbl = np.full((1, self.table_width), NULL_BLOCK, np.int32)
                 tbl[0, : len(req.table)] = req.table
                 req.table_dev = self._upload(tbl)
+            t_p = time.perf_counter()
             try:
                 self._chaos_device("prefill", (lane,))
                 tok = self._prefill(piece, start, req.table, k, req.table_dev)
@@ -1146,6 +1274,13 @@ class PagedServingEngine:
                 # prefilling/decoding lanes are untouched
                 self._fail_request(req, str(fault))
                 continue
+            t_p1 = time.perf_counter()
+            req.prefill_ms += (t_p1 - t_p) * 1e3
+            if self.tracer.enabled:
+                self.tracer.complete(
+                    "prefill_chunk", t_p, t_p1, rid=req.rid,
+                    tokens=len(piece), final=final,
+                )
             req.prefill_pos = start + len(piece)
             self.metrics.prefill_tokens += len(piece)
             self.metrics.prefill_chunks += 1
@@ -1157,6 +1292,8 @@ class PagedServingEngine:
             req.table_dev = None
             req.out.append(tok)
             req.position = req.prefill_target
+            self._note_first_token(req)
+            self.tracer.request_state(req.rid, "active")
             self._tokens[lane] = tok
             self._positions[lane] = req.position
             self._tables[lane, : len(req.table)] = req.table
@@ -1195,6 +1332,8 @@ class PagedServingEngine:
         self._queue.insert(0, req)
         req.preemptions += 1
         self.metrics.preemptions += 1
+        self.tracer.instant("preempt", rid=req.rid, shed=shed)
+        self.tracer.request_state(req.rid, "preempted")
         if not shed:
             self._note_event()  # sustained pool pressure feeds the ladder
         logger.debug(
@@ -1290,6 +1429,8 @@ class PagedServingEngine:
             req.lane = None
         self._finished[req.rid] = req
         self.metrics.finished += 1
+        self._note_terminal(req)
+        self.tracer.request_state(req.rid, "finished")
         if self.paged.audit_debug:
             self._audit(strict=True)
 
@@ -1303,29 +1444,35 @@ class PagedServingEngine:
         run with no step pending (dirty lanes are only ever marked by
         scheduler events, which drain the pipeline first)."""
         if self._table_delta_list:
-            fn = self._table_delta_program()
-            for lane, col, val in self._table_delta_list:
-                if lane in self._dirty_lanes:
-                    continue  # full-lane sync below rewrites the whole row
-                self._d_tables = fn(
-                    self._d_tables,
-                    self._upload(lane), self._upload(col), self._upload(val),
-                )
-                self.metrics.table_deltas += 1
-            self._table_delta_list.clear()
+            with self.tracer.phase(
+                "table_delta_flush", n=len(self._table_delta_list)
+            ):
+                fn = self._table_delta_program()
+                for lane, col, val in self._table_delta_list:
+                    if lane in self._dirty_lanes:
+                        continue  # full-lane sync below rewrites the whole row
+                    self._d_tables = fn(
+                        self._d_tables,
+                        self._upload(lane), self._upload(col), self._upload(val),
+                    )
+                    self.metrics.table_deltas += 1
+                self._table_delta_list.clear()
         if self._dirty_lanes:
             assert self._pending is None, "full-lane sync with step in flight"
-            fn = self._lane_set_program()
-            for lane in sorted(self._dirty_lanes):
-                self._d_tokens, self._d_positions, self._d_tables = fn(
-                    self._d_tokens, self._d_positions, self._d_tables,
-                    self._upload(lane),
-                    self._upload(self._tokens[lane]),
-                    self._upload(self._positions[lane]),
-                    self._upload(self._tables[lane]),
-                )
-                self.metrics.lane_syncs += 1
-            self._dirty_lanes.clear()
+            with self.tracer.phase(
+                "lane_sync_flush", lanes=sorted(self._dirty_lanes)
+            ):
+                fn = self._lane_set_program()
+                for lane in sorted(self._dirty_lanes):
+                    self._d_tokens, self._d_positions, self._d_tables = fn(
+                        self._d_tokens, self._d_positions, self._d_tables,
+                        self._upload(lane),
+                        self._upload(self._tokens[lane]),
+                        self._upload(self._positions[lane]),
+                        self._upload(self._tables[lane]),
+                    )
+                    self.metrics.lane_syncs += 1
+                self._dirty_lanes.clear()
 
     def _read_and_apply(self, pending: tuple) -> None:
         """Read one dispatched step's sampled tokens and advance request
@@ -1423,6 +1570,8 @@ class PagedServingEngine:
         )
         fn = self._decode_program(self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)
+        tr = self.tracer
+        t_d = tr.now() if tr.enabled else 0.0
         finite = None
         if self._check_logits:
             toks, finite, self._d_positions, self.cache = fn(
@@ -1434,6 +1583,11 @@ class PagedServingEngine:
             toks, self._d_positions, self.cache = fn(
                 eng.params, self.cache,
                 self._d_tokens, self._d_positions, self._d_tables, k,
+            )
+        if tr.enabled:
+            tr.complete(
+                "dispatch", t_d, program=program_label(fn), mode="async",
+                lanes=len(decode_lanes),
             )
         self._d_tokens = toks
         self._dispatch_count += 1
@@ -1478,6 +1632,8 @@ class PagedServingEngine:
         )
         fn = self._decode_program(self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)
+        tr = self.tracer
+        t_d = tr.now() if tr.enabled else 0.0
         finite = None
         if self._check_logits:
             toks, finite, self._d_positions, self.cache = fn(
@@ -1489,6 +1645,11 @@ class PagedServingEngine:
             toks, self._d_positions, self.cache = fn(
                 eng.params, self.cache,
                 self._d_tokens, self._d_positions, self._d_tables, k,
+            )
+        if tr.enabled:
+            tr.complete(
+                "dispatch", t_d, program=program_label(fn), mode="sync",
+                lanes=len(decode_lanes),
             )
         self._d_tokens = toks
         self._dispatch_count += 1
@@ -1603,6 +1764,8 @@ class PagedServingEngine:
             int(max(self._positions[l] for l in decode_lanes)) + k + 1
         )
         fn = self._verify_program(kv_limit, k)
+        tr = self.tracer
+        t_d = tr.now() if tr.enabled else 0.0
         if self._check_logits:
             (
                 emitted_d, accept_d, new_tokens, self._d_positions,
@@ -1619,6 +1782,11 @@ class PagedServingEngine:
                 eng.params, self.cache,
                 self._d_tokens, self._d_positions, self._d_tables,
                 self._upload(drafts), self._upload(draft_len),
+            )
+        if tr.enabled:
+            tr.complete(
+                "dispatch", t_d, program=program_label(fn), mode="verify",
+                lanes=len(decode_lanes), drafts=int(draft_len.sum()),
             )
         self._d_tokens = new_tokens
         self._dispatch_count += 1
@@ -1641,6 +1809,8 @@ class PagedServingEngine:
                 continue
             a = int(accept[lane])
             self.metrics.accepted_tokens += a
+            if draft_len[lane]:
+                self.metrics.hist_accept_len.observe(a)
             req.spec_drafted += int(draft_len[lane])
             req.spec_accepted += a
             self._positions[lane] += a + 1  # mirror the on-device advance
@@ -1715,6 +1885,7 @@ class PagedServingEngine:
         t0 = time.perf_counter()
         self._wait_ms = 0.0
         self._step_index += 1
+        self.tracer.begin_step(self._step_index)
         if self.injector is not None:
             self.injector.begin_step(self._step_index)
         try:
@@ -1726,6 +1897,8 @@ class PagedServingEngine:
         total_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.device_wait_ms += self._wait_ms
         self.metrics.host_schedule_ms += max(total_ms - self._wait_ms, 0.0)
+        self.metrics.hist_step_ms.observe(total_ms)
+        self.metrics.hist_queue_depth.observe(len(self._queue))
         self._update_ladder()
         if (
             self.paged.audit_interval
@@ -1738,7 +1911,20 @@ class PagedServingEngine:
             self._last_log_step = steps
             self.metrics.log(logger, self.allocator, self.index)
         self._check_stall()
+        self.tracer.end_step(
+            queue=len(self._queue), active=len(self._active),
+            wait_ms=round(self._wait_ms, 3),
+        )
         return alive
+
+    def export_trace(self, path: str, fmt: str = "chrome") -> str:
+        """Write the graftscope flight recorder (last
+        ``trace_buffer_steps`` steps + every request span) to ``path`` —
+        ``fmt="chrome"`` for trace-event JSON (load in chrome://tracing or
+        https://ui.perfetto.dev), ``"jsonl"`` for line-delimited events.
+        Requires ``PagedConfig.trace_enabled`` (the file is valid but
+        empty otherwise)."""
+        return self.tracer.export(path, fmt=fmt)
 
     def run_to_completion(self) -> Dict[int, List[int]]:
         """Step until idle. Requests that failed terminally (chaos, NaN
@@ -1772,6 +1958,25 @@ class PagedServingEngine:
         req = self._requests.get(rid)
         if req is None:
             raise KeyError(f"unknown request id {rid}")
+        # timing context survives into the terminal record: finished AND
+        # failed requests report ttft/queue/prefill (and tpot once >= 2
+        # tokens exist); fields not reached yet are None
+        ttft_ms = None
+        if req.first_token_at is not None:
+            ttft_ms = round((req.first_token_at - req.submitted_at) * 1e3, 3)
+        tpot_ms = None
+        if (
+            req.finished_at is not None
+            and req.first_token_at is not None
+            and len(req.out) > 1
+        ):
+            tpot_ms = round(
+                (req.finished_at - req.first_token_at) * 1e3
+                / (len(req.out) - 1), 3,
+            )
+        queue_ms = None
+        if req.admitted_at is not None:
+            queue_ms = round((req.admitted_at - req.submitted_at) * 1e3, 3)
         return {
             "rid": req.rid,
             "prompt_tokens": len(req.prompt),
@@ -1782,6 +1987,13 @@ class PagedServingEngine:
             "done": req.done,
             "status": self._status(req),
             "error": req.error,
+            "submitted_at": req.submitted_at,
+            "first_token_at": req.first_token_at,
+            "finished_at": req.finished_at,
+            "queue_ms": queue_ms,
+            "prefill_ms": round(req.prefill_ms, 3),
+            "ttft_ms": ttft_ms,
+            "tpot_ms": tpot_ms,
         }
 
 

@@ -123,6 +123,11 @@ class FaultInjector:
         self.counts: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
         # (step, kind, site, lanes) in firing order — the chaos audit trail
         self.fired: List[tuple] = []
+        # observer called as on_fire(step, kind, site, lanes) at the moment
+        # a fault fires — the engine wires its graftscope tracer here so
+        # chaos events land in the flight recorder as instants. Purely
+        # observational: must never influence what fires.
+        self.on_fire = None
 
     @property
     def total_fired(self) -> int:
@@ -150,6 +155,8 @@ class FaultInjector:
     def _record(self, kind: str, site: str, lanes: Sequence[int]) -> None:
         self.counts[kind] += 1
         self.fired.append((self._step, kind, site, tuple(lanes)))
+        if self.on_fire is not None:
+            self.on_fire(self._step, kind, site, tuple(lanes))
 
     # -- site hooks (called by the engine) ---------------------------------
 

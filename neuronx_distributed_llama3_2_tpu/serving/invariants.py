@@ -60,6 +60,15 @@ class InvariantViolation(AssertionError):
         )
 
 
+def summarize_violations(violations: List[str], limit: int = 3) -> str:
+    """Compact one-line digest of an audit result for trace instants and
+    log lines: the first ``limit`` violations verbatim, plus a count of
+    the rest."""
+    head = "; ".join(violations[:limit])
+    extra = len(violations) - limit
+    return head + (f"; (+{extra} more)" if extra > 0 else "")
+
+
 def audit_engine(engine) -> List[str]:
     """Audit one :class:`.engine.PagedServingEngine`. Returns violation
     strings, [] when every invariant holds. Never raises, never touches

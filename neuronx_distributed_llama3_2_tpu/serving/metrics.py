@@ -3,6 +3,13 @@
 Follows the ``trainer/metrics.py`` house style — plain counters with a
 ``snapshot()`` that merges in allocator/index state, loggable as one JSON
 object (the serving-side analogue of ``TrainingMetrics``'s jsonl records).
+
+graftscope (docs/serving.md "Observability") adds latency distributions:
+``hist_*`` fields are log-bucketed :class:`.histogram.Histogram` objects
+the engine observes into unconditionally (TTFT, TPOT, step latency,
+accept length, queue depth); ``snapshot()`` embeds their p50/p90/p99
+summaries under stable keys and ``prometheus()`` renders the whole
+object as text exposition for a scraper.
 """
 
 from __future__ import annotations
@@ -14,9 +21,27 @@ from typing import Optional
 from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     BlockAllocator,
 )
+from neuronx_distributed_llama3_2_tpu.serving.histogram import Histogram
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
     RadixPrefixIndex,
 )
+
+# dataclass fields exported as prometheus gauges; every other numeric
+# field is a monotonic counter
+_GAUGE_FIELDS = frozenset({
+    "tp_size", "pool_bytes_per_rank", "pool_bytes_total",
+    "degradation_level",
+})
+
+# snapshot key -> hist_* field name (the stable public names dashboards
+# and the golden-key test consume)
+_HIST_KEYS = {
+    "ttft_ms": "hist_ttft_ms",
+    "tpot_ms": "hist_tpot_ms",
+    "step_latency_ms": "hist_step_ms",
+    "accept_len": "hist_accept_len",
+    "queue_depth": "hist_queue_depth",
+}
 
 
 @dataclasses.dataclass
@@ -69,6 +94,21 @@ class ServingMetrics:
     degradation_level: int = 0     # current ladder rung (gauge, 0 = full)
     degradations: int = 0          # ladder climbs taken (cumulative)
     audit_violations: int = 0      # invariant-auditor findings (cumulative)
+    # -- latency distributions (docs/serving.md "Observability"): always
+    #    observed (a bisect + two adds per event), independent of the
+    #    trace_enabled flight recorder. Bucket specs: ms histograms span
+    #    50µs..800s at 2× growth (~24 buckets); accept length and queue
+    #    depth are small integer ranges at 2× --
+    hist_ttft_ms: Histogram = dataclasses.field(
+        default_factory=lambda: Histogram(0.05, 8e5, 2.0))
+    hist_tpot_ms: Histogram = dataclasses.field(
+        default_factory=lambda: Histogram(0.05, 8e5, 2.0))
+    hist_step_ms: Histogram = dataclasses.field(
+        default_factory=lambda: Histogram(0.05, 8e5, 2.0))
+    hist_accept_len: Histogram = dataclasses.field(
+        default_factory=lambda: Histogram(1.0, 64.0, 2.0))
+    hist_queue_depth: Histogram = dataclasses.field(
+        default_factory=lambda: Histogram(1.0, 8192.0, 2.0))
 
     def prefix_skip_fraction(self) -> float:
         """Fraction of admitted prompt tokens that skipped prefill."""
@@ -84,7 +124,15 @@ class ServingMetrics:
         allocator: Optional[BlockAllocator] = None,
         index: Optional[RadixPrefixIndex] = None,
     ) -> dict:
-        rec = dataclasses.asdict(self)
+        # built by hand rather than dataclasses.asdict: asdict would
+        # deep-copy the Histogram objects into the record and break JSON
+        # serialization; the hist_* fields export as summary dicts under
+        # the stable _HIST_KEYS names instead
+        rec = {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(self)
+            if not f.name.startswith("hist_")
+        }
         rec["prefix_skip_fraction"] = round(self.prefix_skip_fraction(), 4)
         rec["accept_rate"] = round(self.accept_rate(), 4)
         rec["host_schedule_ms"] = round(self.host_schedule_ms, 3)
@@ -92,12 +140,46 @@ class ServingMetrics:
         steps = max(self.decode_steps, 1)
         rec["host_schedule_ms_per_step"] = round(self.host_schedule_ms / steps, 4)
         rec["device_wait_ms_per_step"] = round(self.device_wait_ms / steps, 4)
+        for key, field_name in _HIST_KEYS.items():
+            rec[key] = getattr(self, field_name).snapshot()
         if allocator is not None:
             rec.update(allocator.stats())
         if index is not None:
             rec["prefix_hit_rate"] = round(index.hit_rate(), 4)
             rec["radix_nodes"] = index.num_nodes
         return rec
+
+    def prometheus(
+        self,
+        allocator: Optional[BlockAllocator] = None,
+        index: Optional[RadixPrefixIndex] = None,
+    ) -> str:
+        """Prometheus text exposition of the full snapshot: dataclass
+        counters as ``counter``, layout/ladder fields and every derived
+        or allocator/index value as ``gauge``, the ``hist_*`` fields as
+        real histogram series, and the kv dtype as an info label. All
+        names carry a ``serving_`` prefix."""
+        counter_fields = {
+            f.name for f in dataclasses.fields(self)
+            if not f.name.startswith("hist_")
+        } - _GAUGE_FIELDS
+        snap = self.snapshot(allocator, index)
+        lines = [
+            f'serving_info{{kv_dtype="{self.kv_dtype}"}} 1',
+        ]
+        for key in sorted(snap):
+            if key in _HIST_KEYS or key == "kv_dtype":
+                continue
+            val = snap[key]
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            kind = "counter" if key in counter_fields else "gauge"
+            lines.append(f"# TYPE serving_{key} {kind}")
+            lines.append(f"serving_{key} {val:g}")
+        for key, field_name in _HIST_KEYS.items():
+            lines.extend(
+                getattr(self, field_name).prometheus_lines(f"serving_{key}"))
+        return "\n".join(lines) + "\n"
 
     def log(self, logger, allocator=None, index=None) -> None:
         logger.info("serving metrics: %s", json.dumps(self.snapshot(allocator, index)))

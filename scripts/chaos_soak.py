@@ -57,6 +57,10 @@ def build_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--latency-rate", type=float, default=0.05)
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="virtual CPU mesh (testing only)")
+    ap.add_argument("--trace-dir", default=os.environ.get("SERVING_TRACE_DIR"),
+                    help="directory for graftscope artifacts (Chrome trace "
+                    "JSON + prometheus text); defaults to $SERVING_TRACE_DIR; "
+                    "unset = no artifacts")
     args = ap.parse_args(argv)
     if args.smoke:
         args.requests = 8
@@ -116,6 +120,10 @@ def run_bench(args: argparse.Namespace) -> dict:
         spec_draft_tokens=4, stall_step_limit=500, audit_interval=8,
         audit_debug=True, degrade_after_faults=3, degrade_window_steps=32,
         degrade_recover_steps=16,
+        # tracing rides the chaos run unconditionally: the parity gate vs
+        # the untraced baseline doubles as a zero-interference check under
+        # the full feature matrix, and --trace-dir banks the timeline
+        trace_enabled=True, trace_buffer_steps=512,
     )
     # a scheduled entry per class guarantees coverage whatever the rates
     plan = FaultPlan(
@@ -129,8 +137,11 @@ def run_bench(args: argparse.Namespace) -> dict:
     )
 
     def drive(injector):
+        # baseline runs untraced: the parity-of-unaffected gate then also
+        # proves tracing changed no tokens
         cfg = paged_cfg if injector is not None else dataclasses.replace(
-            paged_cfg, audit_interval=0, audit_debug=False
+            paged_cfg, audit_interval=0, audit_debug=False,
+            trace_enabled=False,
         )
         paged = PagedServingEngine(
             InferenceEngine(
@@ -213,6 +224,15 @@ def run_bench(args: argparse.Namespace) -> dict:
         "faults_by_kind": dict(chaos.injector.counts),
         **m.snapshot(chaos.allocator, chaos.index),
     }
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        record["trace_artifact"] = chaos.export_trace(
+            os.path.join(args.trace_dir, "chaos_soak_trace.json")
+        )
+        prom_path = os.path.join(args.trace_dir, "chaos_soak_metrics.prom")
+        with open(prom_path, "w") as f:
+            f.write(m.prometheus(chaos.allocator, chaos.index))
+        record["prometheus_artifact"] = prom_path
     if failures:
         record["gate_failure"] = "; ".join(failures)
     return record

@@ -11,6 +11,11 @@ JSON record to the session artifact (``CHIP_SESSION.jsonl``)::
 
     {"stage": ..., "rc": 0, "seconds": 12.3, "parsed": {...}, "tail": "..."}
 
+Every stage inherits ``SERVING_TRACE_DIR`` (default ``chip_artifacts/``
+in the repo root), so the serving stages bank their graftscope Chrome
+trace + prometheus text alongside the session; files a stage exported
+there are listed under the record's ``artifacts`` key.
+
 Stages (see ``STAGES``, in value-per-chip-minute order): relay probe →
 bench.py (the driver metric) → MFU sweep margin → chip-side TTFT 1B/3B →
 head/ring A/B default gates (early: the provisional defaults are waiting
@@ -43,6 +48,11 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PY = sys.executable
+
+# graftscope artifacts (Chrome traces, prometheus text) land here: every
+# stage inherits SERVING_TRACE_DIR so the serving benches export their
+# flight-recorder timeline alongside the session records
+ART_DIR = os.path.join(REPO, "chip_artifacts")
 
 PROBE_SNIPPET = (
     "import jax, json; "
@@ -111,12 +121,30 @@ def last_json_line(text: str):
     return None
 
 
+def _artifacts_since(t_start: float, art_dir: str) -> list:
+    """Repo-relative paths of artifact files touched at/after ``t_start``
+    (wall clock, with 1 s of mtime slack) — what a stage just exported."""
+    if not os.path.isdir(art_dir):
+        return []
+    found = []
+    for fname in sorted(os.listdir(art_dir)):
+        path = os.path.join(art_dir, fname)
+        try:
+            if os.path.isfile(path) and os.path.getmtime(path) >= t_start - 1.0:
+                found.append(os.path.relpath(path, REPO))
+        except OSError:
+            continue
+    return found
+
+
 def run_stage(name: str, argv: list, timeout_s: float) -> dict:
     env = dict(os.environ)
     # stages never start their own nested session (bench.py runs one
     # post-headline when invoked by the driver; as a session *stage* it
     # must emit only its metric)
     env["BENCH_SESSION"] = "0"
+    # serving stages export graftscope traces into the session artifact dir
+    trace_dir = env.setdefault("SERVING_TRACE_DIR", ART_DIR)
     if name == "bench":
         # keep bench.py's internal retry deadline strictly inside this
         # stage's timeout — an env override (BENCH_DEADLINE_S) larger than
@@ -127,6 +155,7 @@ def run_stage(name: str, argv: list, timeout_s: float) -> dict:
         )
         env["BENCH_DEADLINE_S"] = str(max(internal, 60.0))
     t0 = time.monotonic()
+    wall0 = time.time()
     try:
         proc = subprocess.run(
             argv, capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
@@ -141,7 +170,7 @@ def run_stage(name: str, argv: list, timeout_s: float) -> dict:
     except OSError as e:  # missing/unrunnable stage script — record, don't die
         rc, out, err, status = None, "", str(e), "launch_error"
     seconds = time.monotonic() - t0
-    return {
+    rec = {
         "stage": name,
         "status": status,
         "rc": rc,
@@ -149,6 +178,12 @@ def run_stage(name: str, argv: list, timeout_s: float) -> dict:
         "parsed": last_json_line(out),
         "tail": (out + ("\n--- stderr ---\n" + err if err else ""))[-1500:],
     }
+    # graftscope exports (trace JSON, .prom text) the stage left behind;
+    # keyed only when present so artifact-free stage records are unchanged
+    arts = _artifacts_since(wall0, trace_dir)
+    if arts:
+        rec["artifacts"] = arts
+    return rec
 
 
 def run_session(

@@ -114,7 +114,11 @@ def _engine(kv_cache_dtype="bf16", spec=0):
     )
 
     cfg, params = _tiny()
-    kw = dict(block_size=8, num_blocks=32, kv_cache_dtype=kv_cache_dtype)
+    # the gate engines run with the graftscope flight recorder ON: the
+    # catalog checks (GC003 no host transfers in traces, GC006 fault-free
+    # program registry) then prove tracing never leaks into the programs
+    kw = dict(block_size=8, num_blocks=32, kv_cache_dtype=kv_cache_dtype,
+              trace_enabled=True, trace_buffer_steps=64)
     if spec:
         kw["spec_draft_tokens"] = spec
     return PagedServingEngine(

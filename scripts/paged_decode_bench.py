@@ -91,6 +91,10 @@ def build_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--max-seq-len", type=int, default=256)
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace-dir", default=os.environ.get("SERVING_TRACE_DIR"),
+                    help="directory for graftscope artifacts (Chrome trace "
+                    "JSON + prometheus text from the traced async leg); "
+                    "defaults to $SERVING_TRACE_DIR; unset = no artifacts")
     args = ap.parse_args(argv)
     if args.smoke:
         args.kv_limits = "32"
@@ -284,6 +288,10 @@ def _async_ab(config, params, args):
             PagedConfig(
                 block_size=args.block_size, num_blocks=num_blocks,
                 async_loop=async_loop,
+                # the async leg runs traced against the untraced sync leg:
+                # the parity gate then doubles as a zero-interference check
+                # for the graftscope flight recorder
+                trace_enabled=async_loop,
             ),
         )
         for p in prompts:
@@ -292,11 +300,11 @@ def _async_ab(config, params, args):
         out = paged.run_to_completion()
         wall = time.perf_counter() - t0
         snap = paged.metrics.snapshot()
-        return out, paged.metrics.decode_steps / wall, snap
+        return out, paged.metrics.decode_steps / wall, snap, paged
 
-    out_sync, sync_sps, snap_sync = run(False)
-    out_async, async_sps, snap_async = run(True)
-    return {
+    out_sync, sync_sps, snap_sync, _ = run(False)
+    out_async, async_sps, snap_async, paged_async = run(True)
+    rec = {
         "sync_steps_per_s": round(sync_sps, 2),
         "async_steps_per_s": round(async_sps, 2),
         "async_speedup": round(async_sps / sync_sps, 3),
@@ -308,6 +316,18 @@ def _async_ab(config, params, args):
         "async_host_schedule_ms_per_step": snap_async["host_schedule_ms_per_step"],
         "async_device_wait_ms_per_step": snap_async["device_wait_ms_per_step"],
     }
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        rec["trace_artifact"] = paged_async.export_trace(
+            os.path.join(args.trace_dir, "paged_decode_async_trace.json")
+        )
+        prom_path = os.path.join(args.trace_dir, "paged_decode_metrics.prom")
+        with open(prom_path, "w") as f:
+            f.write(paged_async.metrics.prometheus(
+                paged_async.allocator, paged_async.index
+            ))
+        rec["prometheus_artifact"] = prom_path
+    return rec
 
 
 def _spec_ab(config, params, args):

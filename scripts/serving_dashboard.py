@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Terminal dashboard over ServingMetrics snapshots (graftscope scrape
+surface, docs/serving.md "Observability").
+
+Renders the latest snapshot record as a compact terminal view: request
+counters, pool gauges, degradation-ladder state, and the latency
+histograms (TTFT / TPOT / step) as p50/p90/p99 rows. Input is jsonl of
+``ServingMetrics.snapshot()`` dicts — what ``metrics_log_every`` logs,
+what chaos_soak/paged_decode_bench records embed, or what any engine
+loop writes with ``json.dumps(m.snapshot(...))``.
+
+Usage:
+  python scripts/serving_dashboard.py --file metrics.jsonl        # latest
+  python scripts/serving_dashboard.py --file metrics.jsonl --follow
+  python scripts/serving_dashboard.py --demo   # tiny CPU engine, live
+
+``--follow`` tails the file and redraws on every new record; ``--demo``
+builds the tiny-model paged engine (CPU), drives a small workload, and
+renders as it goes — the zero-hardware smoke of the whole scrape path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+_BAR_WIDTH = 24
+
+
+def _bar(frac: float, width: int = _BAR_WIDTH) -> str:
+    frac = min(max(frac, 0.0), 1.0)
+    n = int(round(frac * width))
+    return "#" * n + "." * (width - n)
+
+
+def _hist_row(label: str, h: dict) -> str:
+    if not h or not h.get("count"):
+        return f"  {label:<10} (no samples)"
+    return (
+        f"  {label:<10} p50 {h['p50']:>9.3f}  p90 {h['p90']:>9.3f}  "
+        f"p99 {h['p99']:>9.3f}  max {h['max']:>9.3f}  (n={h['count']})"
+    )
+
+
+def render_snapshot(snap: dict) -> str:
+    """Pure snapshot-dict -> text renderer (unit-tested; the CLI below is
+    just a loop around it)."""
+    g = snap.get
+    util = float(g("block_utilization", 0.0) or 0.0)
+    lines = [
+        "== serving dashboard ==",
+        (
+            f"requests   submitted {g('submitted', 0)}  "
+            f"finished {g('finished', 0)}  failed {g('failed_requests', 0)}  "
+            f"preempted {g('preemptions', 0)}  truncated {g('truncated', 0)}"
+        ),
+        (
+            f"decode     steps {g('decode_steps', 0)} "
+            f"(async {g('decode_steps_async', 0)}, "
+            f"verify {g('verify_steps', 0)})  "
+            f"accept_rate {g('accept_rate', 0.0)}  "
+            f"prefix_skip {g('prefix_skip_fraction', 0.0)}"
+        ),
+        (
+            f"pool       util {util:.2f} [{_bar(util)}]  "
+            f"free {g('free_blocks', '?')}  evictions {g('evictions', 0)}  "
+            f"h2d_uploads {g('h2d_uploads', 0)}"
+        ),
+        (
+            f"timing     host {g('host_schedule_ms_per_step', 0.0)} ms/step  "
+            f"device_wait {g('device_wait_ms_per_step', 0.0)} ms/step"
+        ),
+        "latency (ms)",
+        _hist_row("ttft", g("ttft_ms", {})),
+        _hist_row("tpot", g("tpot_ms", {})),
+        _hist_row("step", g("step_latency_ms", {})),
+        _hist_row("queue", g("queue_depth", {})),
+        (
+            f"ladder     level {g('degradation_level', 0)}  "
+            f"climbs {g('degradations', 0)}  "
+            f"faults {g('faults_injected', 0)}  "
+            f"violations {g('audit_violations', 0)}"
+        ),
+    ]
+    accept = g("accept_len")
+    if accept and accept.get("count"):
+        lines.insert(9, _hist_row("accept", accept))
+    return "\n".join(lines)
+
+
+def _last_record(path: str) -> dict:
+    last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                last = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    if last is None:
+        raise SystemExit(f"no snapshot records in {path}")
+    return last
+
+
+def _demo() -> int:
+    # the tiny-model CPU engine: exercises the full snapshot -> render
+    # path (and leaves a trace artifact) without hardware
+    import jax
+
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    cfg = LLAMA_CONFIGS["tiny"]
+    params = LlamaForCausalLM(cfg).init(jax.random.key(0))
+    eng = InferenceEngine(
+        cfg, params, max_batch=4, max_seq_len=64, buckets=[8, 16, 32]
+    )
+    paged = PagedServingEngine(
+        eng, GenerationConfig(max_new_tokens=16),
+        PagedConfig(
+            block_size=8, num_blocks=32, async_loop=True,
+            trace_enabled=True,
+        ),
+    )
+    rng = __import__("numpy").random.default_rng(0)
+    for n in (5, 11, 7, 19):
+        paged.submit(rng.integers(1, cfg.vocab_size, size=n).tolist())
+    alive, steps = True, 0
+    while alive:
+        alive = paged.step()
+        steps += 1
+        if steps % 4 == 0 or not alive:
+            print(render_snapshot(
+                paged.metrics.snapshot(paged.allocator, paged.index)
+            ))
+            print()
+    trace = paged.export_trace("serving_demo_trace.json")
+    print(f"trace written to {trace} (load in https://ui.perfetto.dev)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--file", help="jsonl file of snapshot records")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail --file and redraw on new records")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="poll interval for --follow (seconds)")
+    ap.add_argument("--demo", action="store_true",
+                    help="drive the tiny CPU engine and render live")
+    args = ap.parse_args(argv)
+    if args.demo:
+        return _demo()
+    if not args.file:
+        ap.error("--file or --demo required")
+    if not args.follow:
+        print(render_snapshot(_last_record(args.file)))
+        return 0
+    last_size = -1
+    while True:
+        try:
+            size = os.path.getsize(args.file)
+        except OSError:
+            size = -1
+        if size != last_size and size > 0:
+            last_size = size
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(render_snapshot(_last_record(args.file)))
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

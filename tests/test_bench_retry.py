@@ -49,11 +49,14 @@ def test_transient_error_retries_then_forwards_stdout(capsys):
 
     bench.main_with_retries(
         attempts=3, backoff_s=0.0, deadline_s=60.0, attempt_timeout_s=10.0,
-        launch=fake_launch,
+        launch=fake_launch, probe=lambda: "ok",
     )
     assert calls["n"] == 2
     out = capsys.readouterr().out
-    assert json.loads(out.strip())["vs_baseline"] == 1.07
+    rec = json.loads(out.strip())
+    assert rec["vs_baseline"] == 1.07
+    # the up-front relay preflight stamps its verdict into the headline
+    assert rec["preflight"] == "ok"
 
 
 def test_hung_attempt_times_out_and_retries(capsys):
@@ -69,7 +72,7 @@ def test_hung_attempt_times_out_and_retries(capsys):
 
     bench.main_with_retries(
         attempts=3, backoff_s=0.0, deadline_s=60.0, attempt_timeout_s=10.0,
-        launch=fake_launch,
+        launch=fake_launch, probe=lambda: "ok",
     )
     assert calls["n"] == 2
 
@@ -153,5 +156,29 @@ def test_env_overrides(monkeypatch):
         seen["timeout"] = timeout_s
         return "ok", GOOD_LINE, ""
 
-    bench.main_with_retries(launch=fake_launch)
+    bench.main_with_retries(launch=fake_launch, probe=lambda: "ok")
     assert seen["timeout"] == 2.0
+
+
+def test_preflight_verdict_stamped_into_headline(capsys):
+    """The preflight probe runs BEFORE any attempt and its verdict lands in
+    the headline record as provenance — a degraded-relay verdict must ride
+    a healthy-looking number, and surrounding chatter must survive."""
+    bench = _load_bench()
+    probes = {"n": 0}
+
+    def probe():
+        probes["n"] += 1
+        return "backend_init_timeout"
+
+    bench.main_with_retries(
+        attempts=1, backoff_s=0.0, deadline_s=60.0, attempt_timeout_s=10.0,
+        launch=lambda t: ("ok", "# chatter\n" + GOOD_LINE, ""),
+        probe=probe,
+    )
+    assert probes["n"] == 1  # one up-front probe, reused everywhere
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert lines[0] == "# chatter"
+    rec = json.loads(lines[-1])
+    assert rec["preflight"] == "backend_init_timeout"
+    assert rec["vs_baseline"] == 1.07

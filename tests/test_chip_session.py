@@ -169,9 +169,12 @@ def test_bench_ok_path_invokes_post_session_with_headline(bench, capsys):
     bench.main_with_retries(
         attempts=1, backoff_s=0, deadline_s=30, attempt_timeout_s=10,
         launch=lambda t: ("ok", "# chatter\n" + good + "\n", ""),
+        probe=lambda: "ok",
         post_session=post,
     )
-    assert json.loads(seen["headline"]) == json.loads(good)
+    # the headline handed to the session hook carries the preflight stamp
+    assert json.loads(seen["headline"]) == {**json.loads(good),
+                                            "preflight": "ok"}
     assert isinstance(seen["start"], float)
     capsys.readouterr()
 
