@@ -35,6 +35,15 @@ GC005  quantized-pool arithmetic: values leaving an int8/fp8 array must
 GC006  program-registry purity: a fault-free engine compiles no
        ``checked`` program variants and an undegraded engine no
        gather-fallback variants.
+GC007  closed catalog: every ``engine._programs`` key must be derivable
+       from the declared :class:`..serving.catalog.CatalogManifest` —
+       an out-of-ladder compile is a finding naming the offending key
+       and the nearest legal bucket.
+GC008  steady-state compile freeze: after prewarm/first traffic marks
+       the registry steady (``engine._frozen_keys``), growing the key
+       set or re-lowering an existing key at different avals is flagged
+       (the static twin of a recompile stall). Ladder-driven gather
+       twins on a degraded engine are exempt.
 
 Suppression: jaxprs have no source lines to annotate, so suppression is
 per (program, rule) — pass ``suppress={"GC003", ...}`` to the check
@@ -88,6 +97,8 @@ GC_RULES: Dict[str, str] = {
     "GC004": "collective in a collective-free region or on an undeclared axis",
     "GC005": "low-bit (quantized-pool) value used without fp32 widening",
     "GC006": "fault-free engine compiled a checked/gather program variant",
+    "GC007": "program key not derivable from the declared catalog manifest",
+    "GC008": "registry grew or a key re-lowered after the steady-state freeze",
 }
 
 #: default axis universe for GC004 — kept in sync with parallel/state.py
@@ -554,6 +565,70 @@ def _donated_leaf_count(rec: Any) -> int:
     return total
 
 
+def _trace_cache_size(rec: Any) -> Optional[int]:
+    """Distinct traces held by the record's jit wrapper, read through the
+    private-but-stable ``_cache_size`` probe. None when the jax build has
+    no probe — GC008's re-lower arm then degrades to registry-growth
+    detection only."""
+    try:
+        return int(rec.jitted._cache_size())
+    except Exception:
+        return None
+
+
+def _check_freeze(
+    key: Tuple, rec: Any, frozen: FrozenSet, never_degraded: bool
+) -> List[Finding]:
+    """GC008 body: a key outside the freeze set means the registry grew
+    mid-traffic; a frozen key whose trace cache holds more than one entry
+    was re-lowered at different avals. Both are the static shadow of a
+    production recompile stall. Gather twins on a degraded engine are the
+    one legitimate post-freeze compile (the ladder's kernel-shed rung)."""
+    from neuronx_distributed_llama3_2_tpu.serving.catalog import format_key
+
+    label = _registry_label(rec)
+    if key not in frozen:
+        if not never_degraded and rec.gather:
+            return []  # ladder shed past the freeze: sanctioned twin
+        return [
+            Finding(
+                rule="GC008",
+                program=label,
+                message=(
+                    f"program key {format_key(key)} compiled after the "
+                    "steady-state freeze (registry grew mid-traffic)"
+                ),
+                hint=(
+                    "prewarm should cover every reachable key before "
+                    "traffic; extend the ladder or PagedConfig buckets so "
+                    "this shape is pre-lowered, or re-run mark_steady() "
+                    "after intentional catalog growth"
+                ),
+                detail="new:" + format_key(key),
+            )
+        ]
+    n = _trace_cache_size(rec)
+    if n is not None and n > 1:
+        return [
+            Finding(
+                rule="GC008",
+                program=label,
+                message=(
+                    f"frozen program key {format_key(key)} re-lowered "
+                    f"after the freeze ({n} traces in the jit cache — "
+                    "dispatch avals drifted)"
+                ),
+                hint=(
+                    "a second trace means some dispatch passed different "
+                    "shapes/dtypes than prewarm did; align the dispatch "
+                    "args (aval twins) or widen the bucket it pads into"
+                ),
+                detail=f"relower:{n}",
+            )
+        ]
+    return []
+
+
 def audit_programs(
     engine: Any, suppress: Iterable[str] = ()
 ) -> List[Finding]:
@@ -566,6 +641,14 @@ def audit_programs(
     - GC006 on the *key population*: a fault-free engine (no injector, no
       ``detect_nonfinite``) must hold no ``checked`` variants; an engine
       that never climbed the degradation ladder no ``gather`` variants.
+    - GC007 on every key: it must be a member of the engine's declared
+      catalog manifest expansion (``engine.catalog.keys()``); the finding
+      names the nearest legal bucket.
+    - GC008 after the steady-state freeze (``engine.mark_steady()`` /
+      prewarm): keys compiled after the freeze, or frozen keys whose jit
+      trace cache grew past one entry (a re-lower at different avals),
+      are findings. Gather twins on a degraded engine are exempt — the
+      ladder is allowed to shed to gather mid-traffic.
     - For records that actually dispatched (example avals recorded):
       GC002 on the lowered program's donation aliasing; GC003/GC004 on
       the retraced jaxpr; GC001 on decode/verify programs whose trace
@@ -581,9 +664,42 @@ def audit_programs(
     findings: List[Finding] = []
     fault_free = engine.injector is None and not engine.paged.detect_nonfinite
     never_degraded = engine.metrics.degradations == 0
+    # catalog contract inputs: the manifest is engine-construction state,
+    # the freeze set is None until mark_steady()/prewarm() runs. getattr
+    # keeps the auditor usable on pre-catalog engine doubles in tests.
+    manifest = getattr(engine, "catalog", None)
+    legal = manifest.keys() if manifest is not None else None
+    frozen = getattr(engine, "_frozen_keys", None)
 
-    for rec in engine.program_registry().values():
+    for key, rec in engine.program_registry().items():
         label = _registry_label(rec)
+        if legal is not None and "GC007" not in suppress and key not in legal:
+            from neuronx_distributed_llama3_2_tpu.serving.catalog import (
+                format_key,
+                nearest_key,
+            )
+
+            near = nearest_key(key, legal)
+            findings.append(
+                Finding(
+                    rule="GC007",
+                    program=label,
+                    message=(
+                        f"program key {format_key(key)} is not derivable "
+                        "from the declared catalog manifest"
+                        + (f" (nearest legal bucket: {near})" if near else "")
+                    ),
+                    hint=(
+                        "widen PagedConfig.kv_buckets/prefill_buckets (or "
+                        "the sampling/verify variants) so the ladder covers "
+                        "this shape, then refresh the golden with "
+                        "graftcheck_gate.py --write-catalog"
+                    ),
+                    detail=format_key(key),
+                )
+            )
+        if frozen is not None and "GC008" not in suppress:
+            findings.extend(_check_freeze(key, rec, frozen, never_degraded))
         if "GC006" not in suppress:
             if fault_free and rec.checked:
                 findings.append(

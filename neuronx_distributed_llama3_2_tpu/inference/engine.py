@@ -61,23 +61,26 @@ def read_host_tokens(tokens: jax.Array) -> np.ndarray:
 
 def default_buckets(max_seq_len: int, min_bucket: int = 128) -> List[int]:
     """Powers-of-2 bucket ladder up to max_seq_len (reference
-    autobucketing.py:6 generate_buckets)."""
-    buckets = []
-    b = min_bucket
-    while b < max_seq_len:
-        buckets.append(b)
-        b *= 2
-    buckets.append(max_seq_len)
-    return buckets
+    autobucketing.py:6 generate_buckets).
+
+    Canonical implementation lives in ``serving/catalog.py`` (the bucket
+    ladder and the compiled-program manifest share one ladder); this
+    re-export keeps the historical import path. The import is call-time
+    because ``serving`` imports this module at package init."""
+    from neuronx_distributed_llama3_2_tpu.serving.catalog import (
+        default_buckets as _impl,
+    )
+    return _impl(max_seq_len, min_bucket)
 
 
 def pick_bucket(buckets: Sequence[int], length: int) -> int:
     """Smallest bucket >= length (reference context-encode bucket-from-extent,
-    autobucketing.py:62-124)."""
-    for b in buckets:
-        if b >= length:
-            return b
-    raise ValueError(f"length {length} exceeds largest bucket {buckets[-1]}")
+    autobucketing.py:62-124). Canonical implementation in
+    ``serving/catalog.py`` — see :func:`default_buckets`."""
+    from neuronx_distributed_llama3_2_tpu.serving.catalog import (
+        pick_bucket as _impl,
+    )
+    return _impl(buckets, length)
 
 
 @dataclasses.dataclass(frozen=True)

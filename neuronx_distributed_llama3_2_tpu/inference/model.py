@@ -192,7 +192,13 @@ class LlamaDecode:
         so a rank's scale slice always matches its payload slice and dequant
         needs no collective."""
         ha = _head_axis(self.config.num_kv_heads)
-        spec = P(None, None, None, ha, None)
+        # no trailing None: GSPMD normalizes specs by dropping trailing
+        # unsharded axes, so program *outputs* come back as
+        # P(None, None, None, ha). Declaring the canonical form here keeps
+        # the constructed pool and every program output on ONE sharding —
+        # otherwise each program re-lowers on its second dispatch under a
+        # tp mesh (caught by graftcheck GC008's trace-cache probe)
+        spec = P(None, None, None, ha)
         if not quantized:
             return PagedKVCache(k=spec, v=spec)
         sspec = P(None, None, None, ha)
@@ -751,6 +757,16 @@ class LlamaDecode:
             if self.config.num_kv_heads % tp or self.config.num_heads % tp:
                 return False
         return True
+
+    def paged_dispatch_path(self, t: int, tree=None) -> str:
+        """Public name for the kernel/gather dispatch decision at fresh-block
+        width ``t``: ``"kernel"`` when :meth:`_paged_kernel_eligible` admits
+        the Pallas paged-decode kernel, ``"gather"`` otherwise. The serving
+        bucket catalog (``serving/catalog.py`` :func:`validate_ladder`) uses
+        this to warn when a declared verify-t rung silently lands on the
+        dense-gather fallback — the ladder should only promise buckets the
+        fast path actually serves."""
+        return "kernel" if self._paged_kernel_eligible(t, tree) else "gather"
 
     def _mlp_block(self, lp: Params, h: jax.Array) -> jax.Array:
         """Post-attention feed-forward on the normed hidden (b,T,H).

@@ -13,6 +13,13 @@ from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     AllocatorError,
     BlockAllocator,
 )
+from neuronx_distributed_llama3_2_tpu.serving.catalog import (
+    BucketLadder,
+    CatalogManifest,
+    default_buckets,
+    format_key,
+    pick_bucket,
+)
 from neuronx_distributed_llama3_2_tpu.serving.drafter import (
     DraftProposer,
     NGramDrafter,
@@ -49,6 +56,8 @@ __all__ = [
     "NULL_BLOCK",
     "AllocatorError",
     "BlockAllocator",
+    "BucketLadder",
+    "CatalogManifest",
     "DraftProposer",
     "EngineStalledError",
     "EngineTracer",
@@ -63,7 +72,10 @@ __all__ = [
     "RadixPrefixIndex",
     "ServingMetrics",
     "audit_engine",
+    "default_buckets",
+    "format_key",
     "make_serving_engine",
+    "pick_bucket",
     "program_label",
     "summarize_violations",
 ]

@@ -47,8 +47,13 @@ import numpy as np
 from neuronx_distributed_llama3_2_tpu.inference.engine import (
     GenerationConfig,
     InferenceEngine,
-    pick_bucket,
     read_host_tokens,
+)
+from neuronx_distributed_llama3_2_tpu.serving.catalog import (
+    CatalogManifest,
+    complete_ladder,
+    pick_bucket,
+    validate_ladder,
 )
 from neuronx_distributed_llama3_2_tpu.serving.faults import (
     EngineStalledError,
@@ -217,13 +222,30 @@ class PagedConfig:
     # ladder instants) into a per-step ring buffer, exportable as Chrome
     # trace-event JSON via engine.export_trace(path). Pure host-side
     # python around the existing funnels: no uploads, no syncs, no new
-    # program keys (graftcheck GC003/GC006 hold with tracing on). Request
-    # timestamps and the latency histograms are metrics, not tracing —
-    # they stay on regardless of this flag.
+    # program keys (graftcheck GC003/GC006 — and the GC007/GC008 catalog
+    # contract — hold with tracing on). Request timestamps and the
+    # latency histograms are metrics, not tracing — they stay on
+    # regardless of this flag.
     trace_enabled: bool = False
     # ring-buffer capacity of the flight recorder: only the last N steps
     # are retained, so trace memory is bounded however long the engine runs
     trace_buffer_steps: int = 256
+    # -- compiled-program catalog (docs/serving.md "Compiled-program
+    #    catalog"; serving/catalog.py) --
+    # override the serving bucket ladders dispatch shapes pad into.
+    # kv_buckets: the kv_limit attention extents of decode/verify/suffix
+    # programs; prefill_buckets: the padded prompt/chunk token counts of
+    # pctx/psfx programs. None = the InferenceEngine's bucket ladder.
+    # Either ladder gets max_seq_len appended when it tops out early (a
+    # dispatch past the ladder must still route somewhere).
+    kv_buckets: Optional[tuple] = None
+    prefill_buckets: Optional[tuple] = None
+    # compile the ENTIRE declared CatalogManifest at engine start through
+    # _register_program, then freeze the registry (mark_steady): no
+    # request ever pays a compile in its TTFT, and graftcheck GC007/GC008
+    # turn any out-of-catalog or post-freeze compile into a finding.
+    # Supersedes the precompile flag's partial warmup.
+    prewarm: bool = False
 
 
 @dataclasses.dataclass
@@ -319,11 +341,18 @@ class PagedServingEngine:
         # steps left before the next draft attempt while the async
         # lookahead owns the loop (PagedConfig.spec_retry_steps)
         self._spec_pause = 0
-        # suffix prefill must route any length <= max_seq_len even when the
-        # bucket ladder tops out early (dense decode has the same fallback)
-        self._prefill_buckets = list(engine.buckets)
-        if self._prefill_buckets[-1] < engine.max_seq_len:
-            self._prefill_buckets.append(engine.max_seq_len)
+        # declared bucket ladders (serving/catalog.py): every dispatch
+        # shape pads into one of these rungs, so the compiled-program set
+        # is O(ladder) however heterogeneous traffic gets. Suffix prefill
+        # must route any length <= max_seq_len even when a ladder tops
+        # out early (dense decode has the same clamp fallback), so
+        # complete_ladder appends max_seq_len to both.
+        self._prefill_buckets = complete_ladder(
+            paged.prefill_buckets or engine.buckets, engine.max_seq_len
+        )
+        self._kv_buckets = complete_ladder(
+            paged.kv_buckets or engine.buckets, engine.max_seq_len
+        )
         # table width: logical blocks covering max_seq_len, plus overflow
         # entries (always null) absorbing bucket-padding writes past it —
         # sized by the largest prefill bucket so a padded suffix prefill
@@ -360,6 +389,12 @@ class PagedServingEngine:
             state as parallel_state,
         )
 
+        # mesh-replicated committed sharding for the device-resident state:
+        # programs return their outputs committed to NamedSharding(mesh, P()),
+        # so constructing the residents on the SAME sharding keeps every
+        # dispatch on one lowering (uncommitted single-device inputs would
+        # re-lower each program on its second call — graftcheck GC008)
+        self._replicated_sharding = None
         if parallel_state.model_parallel_is_initialized():
             from neuronx_distributed_llama3_2_tpu.parallel.layers import (
                 shard_pytree,
@@ -369,6 +404,11 @@ class PagedServingEngine:
                 self.cache,
                 self.model.paged_cache_specs(quantized=self._kv_quantized),
             )
+            mesh = parallel_state.get_parallel_state().mesh
+            if mesh.size > 1:
+                self._replicated_sharding = jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec()
+                )
         self.allocator = BlockAllocator(paged.num_blocks, bs)
         self.index = RadixPrefixIndex(self.allocator)
         self.metrics = ServingMetrics()
@@ -395,6 +435,17 @@ class PagedServingEngine:
         # steady-state dispatch stays zero-upload (a mask uploads only on
         # the steps a nan fault actually fires)
         self._zero_mask = None
+        # the declared compiled-program catalog (serving/catalog.py):
+        # ladder × variant flags expanded into the exact legal key set of
+        # the _programs registry — graftcheck GC007 audits every key
+        # against it, prewarm() compiles it up front
+        self.catalog = CatalogManifest.from_engine(self)
+        # steady-state compile freeze (graftcheck GC008): mark_steady()
+        # snapshots the registry keys; any later _register_program call
+        # counts as a steady-state compile (gather-rung twins exempted
+        # while the degradation ladder is active)
+        self._frozen_keys: Optional[frozenset] = None
+        self._prewarming = False
         if injector is not None:
             self.allocator.fault_hook = injector.alloc_fault
         # degradation ladder state (docs/serving.md): level 0 = everything
@@ -454,9 +505,9 @@ class PagedServingEngine:
         # async) consumes these arrays; the decode program writes its
         # sampled token and incremented position back into them, so a
         # steady-state step needs zero host→device transfers
-        self._d_tokens = jnp.asarray(self._tokens)
-        self._d_positions = jnp.asarray(self._positions)
-        self._d_tables = jnp.asarray(self._tables)
+        self._d_tokens = self._pin(jnp.asarray(self._tokens))
+        self._d_positions = self._pin(jnp.asarray(self._positions))
+        self._d_tables = self._pin(jnp.asarray(self._tables))
         # advanced positions are clamped here: keeps a long-idle garbage
         # lane's position inside the rope table (see LlamaDecode.decode_step)
         self._pos_cap = self.table_width * bs - 1
@@ -472,6 +523,7 @@ class PagedServingEngine:
         self._last_readback_lag = 0  # dispatches between dispatch and read
         self._wait_ms = 0.0          # per-step readback wait scratch
         self._last_log_step = 0      # dedupe periodic metrics logging
+        self._last_prefill_bucket = 0  # bucket of the most recent prefill
         self._programs: Dict[tuple, ProgramRecord] = {}
         if self._kv_quantized:
             # COW copies the block's scale tile with its payload — the scale
@@ -493,7 +545,9 @@ class PagedServingEngine:
             ("copy_block", self._kv_quantized), _copy_block,
             donate_argnums=(0,), kind="copy_block",
         )
-        if precompile:
+        if paged.prewarm:
+            self.prewarm()
+        elif precompile:
             self._warmup()
 
     # -- programs ----------------------------------------------------------
@@ -525,12 +579,48 @@ class PagedServingEngine:
             jitted=jax.jit(fn, donate_argnums=donate_argnums),
         )
         self._programs[key_] = rec
+        self.metrics.programs_compiled += 1
+        if self._prewarming:
+            self.metrics.prewarm_compiles += 1
+        elif self._frozen_keys is not None and not gather:
+            # a compile after the steady-state freeze is a TTFT/TPOT
+            # stall under real traffic — the runtime twin of graftcheck
+            # GC008. Gather twins are exempt: the degradation ladder's
+            # kernel-shed rung mints them deliberately on first climb.
+            self.metrics.steadystate_compiles += 1
         return rec
 
     def program_registry(self) -> Dict[tuple, ProgramRecord]:
         """key -> :class:`ProgramRecord` for every program this engine has
         built (the graftcheck audit surface; see ``audit_programs``)."""
         return dict(self._programs)
+
+    def catalog_manifest(self) -> CatalogManifest:
+        """The declared compiled-program catalog (serving/catalog.py) —
+        static for the engine's lifetime; ``catalog.keys()`` is the GC007
+        legality universe for :meth:`program_registry`."""
+        return self.catalog
+
+    def _kv_bucket(self, needed: int) -> int:
+        """kv_limit rung covering ``needed`` rows over the serving kv
+        ladder (``PagedConfig.kv_buckets`` or the InferenceEngine's
+        buckets) — the serving twin of ``InferenceEngine._kv_bucket``,
+        with the same clamp-to-full-cache fallback past the ladder top
+        (verify write frontiers may briefly exceed max_seq_len)."""
+        for b in self._kv_buckets:
+            if b >= needed:
+                return b
+        return self._kv_buckets[-1]
+
+    def mark_steady(self) -> None:
+        """Freeze the compiled-program registry: graftcheck GC008 flags
+        any key added — or re-lowered at new avals — after this point
+        (gather twins exempted while the degradation ladder is active),
+        and later compiles count in ``metrics.steadystate_compiles``.
+        Called automatically at the end of :meth:`prewarm`; a soak
+        harness warming up through real traffic instead can call it once
+        its working set has compiled."""
+        self._frozen_keys = frozenset(self._programs)
 
     def _step_model(self):
         """The model instance new program traces bind: normally
@@ -734,6 +824,18 @@ class PagedServingEngine:
         )
 
     # -- host<->device choke points ---------------------------------------
+
+    def _pin(self, x):
+        """Commit a freshly constructed device-RESIDENT array to the
+        mesh-replicated sharding the engine programs produce for it. Under
+        a multi-chip mesh an uncommitted single-device array and a
+        committed replicated one are *different lowerings* to jit, so a
+        resident constructed without this pays one re-lower per program
+        on its second dispatch (the recompile class GC008 exists to
+        catch). No-op off-mesh."""
+        if self._replicated_sharding is None:
+            return x
+        return jax.device_put(x, self._replicated_sharding)
 
     def _upload(self, x, dtype=jnp.int32):
         """Every host→device transfer on the serving path funnels through
@@ -1013,12 +1115,9 @@ class PagedServingEngine:
         compile lazily on first hit — chunked prefill will collapse that
         program family."""
         eng = self.engine
-        kv_buckets = list(eng.buckets)
-        if kv_buckets[-1] < eng.max_seq_len:
-            kv_buckets.append(eng.max_seq_len)
         key = jax.random.key(0)
         zeros_b = jnp.zeros((eng.max_batch,), jnp.int32)
-        for kv in kv_buckets:
+        for kv in self._kv_buckets:
             fn = self._decode_program(self.gen.sampling, kv)
             # positions are donated per call — hand each warmup its own
             # throwaway array; the resident state itself is untouched
@@ -1037,6 +1136,108 @@ class PagedServingEngine:
                 eng.params, self.cache, jnp.zeros((1, bucket), jnp.int32),
                 jnp.ones((1,), jnp.int32), table1, key,
             )
+
+    def prewarm(self) -> None:
+        """Compile the FULL declared catalog (``catalog.prewarm_keys()``)
+        before any traffic, then :meth:`mark_steady` — no request ever
+        pays a compile in its TTFT, and every later compile is a
+        graftcheck GC008 finding. Dispatch arguments are aval twins of
+        the real traffic arguments (every warmup call traces at exactly
+        the shapes/dtypes traffic will dispatch at, so the jit trace
+        cache holds ONE entry per program afterwards — the GC008
+        re-lower check counts on that). Like ``_warmup``, every dispatch
+        writes only into the null block or rewrites current resident
+        values, so token identity is untouched; plain ``jnp`` uploads
+        keep the ``h2d_uploads`` choke-point counter at zero."""
+        eng = self.engine
+        self._prewarming = True
+        try:
+            key = jax.random.key(0)
+            zeros_b = jnp.zeros((eng.max_batch,), jnp.int32)
+            table1 = jnp.full((1, self.table_width), NULL_BLOCK, jnp.int32)
+            zero = jnp.asarray(0, jnp.int32)
+            for key_ in self.catalog.prewarm_keys():
+                kind = key_[0]
+                if kind == "copy_block":
+                    # copy the null block onto itself: garbage -> garbage
+                    self.cache = self._copy_block_fn(self.cache, zero, zero)
+                elif kind == "lane_set":
+                    # rewrite lane 0's resident state with its current
+                    # values (zeros + all-null table row)
+                    fn = self._lane_set_program()
+                    self._d_tokens, self._d_positions, self._d_tables = fn(
+                        self._d_tokens, self._d_positions, self._d_tables,
+                        zero, zero, zero,
+                        jnp.full((self.table_width,), NULL_BLOCK, jnp.int32),
+                    )
+                elif kind == "table_delta":
+                    fn = self._table_delta_program()
+                    self._d_tables = fn(
+                        self._d_tables, zero, zero,
+                        jnp.asarray(NULL_BLOCK, jnp.int32),
+                    )
+                elif kind == "pctx":
+                    _, bucket, cfg, _g = key_
+                    fn = self._prefill_ctx_program(bucket, cfg)
+                    _, self.cache = fn(
+                        eng.params, self.cache,
+                        jnp.zeros((1, bucket), jnp.int32),
+                        jnp.ones((1,), jnp.int32), table1, key,
+                    )
+                elif kind == "psfx":
+                    _, bucket, kv, cfg, _g = key_
+                    fn = self._prefill_suffix_program(bucket, kv, cfg)
+                    _, self.cache = fn(
+                        eng.params, self.cache,
+                        jnp.zeros((1, bucket), jnp.int32),
+                        jnp.ones((1,), jnp.int32),
+                        jnp.ones((1,), jnp.int32), table1, key,
+                    )
+                elif kind == "pdecode":
+                    _, cfg, kv, _g, _c = key_
+                    fn = self._decode_program(cfg, kv)
+                    # dispatch THE residents exactly like _step's decode
+                    # (same committedness/sharding → same lowering) and
+                    # reassign the donated outputs; every table row is
+                    # still NULL, so the write lands in the null block and
+                    # admission's lane_set rewrites the lane state anyway
+                    args = (
+                        eng.params, self.cache, self._d_tokens,
+                        self._d_positions, self._d_tables, key,
+                    )
+                    if self._check_logits:
+                        toks, _, self._d_positions, self.cache = fn(
+                            *args, self._nan_mask((), "warmup")
+                        )
+                    else:
+                        toks, self._d_positions, self.cache = fn(*args)
+                    self._d_tokens = toks
+                elif kind == "pverify":
+                    _, kv, k, _g, _c = key_
+                    fn = self._verify_program(kv, k)
+                    args = (
+                        eng.params, self.cache, self._d_tokens,
+                        self._d_positions, self._d_tables,
+                        jnp.zeros((eng.max_batch, k), jnp.int32), zeros_b,
+                    )
+                    if self._check_logits:
+                        _, _, toks, self._d_positions, _, self.cache = fn(
+                            *args, self._nan_mask((), "warmup")
+                        )
+                    else:
+                        _, _, toks, self._d_positions, self.cache = fn(*args)
+                    self._d_tokens = toks
+                else:  # pragma: no cover - manifest/engine kind drift
+                    raise ValueError(f"prewarm: unknown program kind {kind!r}")
+            for warning in validate_ladder(self.model, self.catalog.ladder):
+                logger.warning("catalog: %s", warning)
+            logger.info(
+                "prewarmed %d program(s): %s",
+                self.metrics.prewarm_compiles, self.catalog.describe(),
+            )
+        finally:
+            self._prewarming = False
+        self.mark_steady()
 
     # -- request lifecycle -------------------------------------------------
 
@@ -1188,6 +1389,8 @@ class PagedServingEngine:
                 self.tracer.complete(
                     "prefill", t_p, t_p1, rid=req.rid,
                     tokens=len(suffix), cached=cached,
+                    bucket=self._last_prefill_bucket,
+                    pad=self._last_prefill_bucket - max(len(suffix), 1),
                 )
             req.out.append(first)
             req.position = len(seq)
@@ -1217,6 +1420,7 @@ class PagedServingEngine:
         of an admission instead of re-uploading it each time."""
         eng = self.engine
         bucket = pick_bucket(self._prefill_buckets, max(len(suffix), 1))
+        self._last_prefill_bucket = bucket  # tracer pad-waste tag
         ids = np.zeros((1, bucket), np.int32)
         ids[0, : len(suffix)] = suffix
         length = np.asarray([max(len(suffix), 1)], np.int32)
@@ -1231,7 +1435,7 @@ class PagedServingEngine:
                 self._upload(length), table_dev, key,
             )
         else:
-            kv_limit = eng._kv_bucket(min(cached + bucket, eng.max_seq_len))
+            kv_limit = self._kv_bucket(min(cached + bucket, eng.max_seq_len))
             fn = self._prefill_suffix_program(bucket, kv_limit, self.gen.sampling)
             tok, self.cache = fn(
                 eng.params, self.cache, self._upload(ids),
@@ -1280,6 +1484,8 @@ class PagedServingEngine:
                 self.tracer.complete(
                     "prefill_chunk", t_p, t_p1, rid=req.rid,
                     tokens=len(piece), final=final,
+                    bucket=self._last_prefill_bucket,
+                    pad=self._last_prefill_bucket - max(len(piece), 1),
                 )
             req.prefill_pos = start + len(piece)
             self.metrics.prefill_tokens += len(piece)
@@ -1565,9 +1771,8 @@ class PagedServingEngine:
         ]
         self._chaos_device("decode", decode_lanes)
         eng = self.engine
-        kv_limit = eng._kv_bucket(
-            int(max(self._positions[l] for l in decode_lanes)) + 1
-        )
+        kv_need = int(max(self._positions[l] for l in decode_lanes)) + 1
+        kv_limit = self._kv_bucket(kv_need)
         fn = self._decode_program(self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)
         tr = self.tracer
@@ -1587,7 +1792,8 @@ class PagedServingEngine:
         if tr.enabled:
             tr.complete(
                 "dispatch", t_d, program=program_label(fn), mode="async",
-                lanes=len(decode_lanes),
+                lanes=len(decode_lanes), kv_bucket=kv_limit,
+                kv_pad=kv_limit - kv_need,
             )
         self._d_tokens = toks
         self._dispatch_count += 1
@@ -1627,9 +1833,8 @@ class PagedServingEngine:
         self._chaos_device("decode", decode_lanes)
         self._flush_state()
         eng = self.engine
-        kv_limit = eng._kv_bucket(
-            int(max(self._positions[l] for l in decode_lanes)) + 1
-        )
+        kv_need = int(max(self._positions[l] for l in decode_lanes)) + 1
+        kv_limit = self._kv_bucket(kv_need)
         fn = self._decode_program(self.gen.sampling, kv_limit)
         self._key, k = jax.random.split(self._key)
         tr = self.tracer
@@ -1649,7 +1854,8 @@ class PagedServingEngine:
         if tr.enabled:
             tr.complete(
                 "dispatch", t_d, program=program_label(fn), mode="sync",
-                lanes=len(decode_lanes),
+                lanes=len(decode_lanes), kv_bucket=kv_limit,
+                kv_pad=kv_limit - kv_need,
             )
         self._d_tokens = toks
         self._dispatch_count += 1
@@ -1760,9 +1966,8 @@ class PagedServingEngine:
         for lane, d in proposals.items():
             drafts[lane, : len(d)] = d
             draft_len[lane] = len(d)
-        kv_limit = eng._kv_bucket(
-            int(max(self._positions[l] for l in decode_lanes)) + k + 1
-        )
+        kv_need = int(max(self._positions[l] for l in decode_lanes)) + k + 1
+        kv_limit = self._kv_bucket(kv_need)
         fn = self._verify_program(kv_limit, k)
         tr = self.tracer
         t_d = tr.now() if tr.enabled else 0.0
@@ -1787,6 +1992,7 @@ class PagedServingEngine:
             tr.complete(
                 "dispatch", t_d, program=program_label(fn), mode="verify",
                 lanes=len(decode_lanes), drafts=int(draft_len.sum()),
+                kv_bucket=kv_limit, kv_pad=kv_limit - kv_need,
             )
         self._d_tokens = new_tokens
         self._dispatch_count += 1

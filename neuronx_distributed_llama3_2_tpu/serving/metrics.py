@@ -86,6 +86,16 @@ class ServingMetrics:
     verify_steps: int = 0          # of decode_steps, multi-token verifies
     spec_disabled_lanes: int = 0   # requests dropped to plain decode (low
     #                                accept rate past probation)
+    # -- compiled-program catalog (docs/serving.md "Compiled-program
+    #    catalog"): every _register_program hit bumps programs_compiled;
+    #    compiles during PagedServingEngine.prewarm() count as
+    #    prewarm_compiles; compiles after mark_steady() freezes the key
+    #    set count as steadystate_compiles (the runtime twin of
+    #    graftcheck GC008 — soak tests assert it stays 0). Ladder-driven
+    #    gather twins are exempt from the steady-state counter --
+    programs_compiled: int = 0     # ProgramRecord registrations (lifetime)
+    prewarm_compiles: int = 0      # of those, made by prewarm()
+    steadystate_compiles: int = 0  # of those, made after the freeze
     # -- fault tolerance (docs/serving.md "Failure handling & degradation") --
     faults_injected: int = 0       # chaos events fired by the FaultInjector
     failed_requests: int = 0       # requests ended in terminal `failed`

@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """graftcheck CI gate: trace the serving engine's representative programs
-and enforce the GC001-GC006 program-level rules.
+and enforce the GC001-GC008 program-level rules.
 
 Usage:
     python scripts/graftcheck_gate.py                   # run the catalog
     python scripts/graftcheck_gate.py --list            # list catalog entries
     python scripts/graftcheck_gate.py --rules           # print the catalogue
     python scripts/graftcheck_gate.py --write-baseline
+    python scripts/graftcheck_gate.py --catalog-diff    # manifest vs registry
+    python scripts/graftcheck_gate.py --write-catalog   # refresh the golden
 
 Where shardlint_gate.py lints source ASTs, this gate lints *programs*: it
 builds tiny CPU-hosted serving engines, runs a few requests so the real
@@ -16,6 +18,15 @@ audit, registry purity), and direct-traces the decode/verify/tp=2/int8
 variants for the shape- and dtype-level rules. Exit status is nonzero iff
 a finding is NOT in the baseline file. Baselining is an explicit,
 reviewed act: run with ``--write-baseline`` and commit with a rationale.
+
+The ``catalog-*`` entries enforce the GC007/GC008 bounded-catalog
+contract end to end: a prewarmed engine is driven through a deliberately
+heterogeneous workload (mixed prompt lengths straddling the chunk size,
+spec verify, int8, tp=2) and the resulting program registry must be
+*byte-identical* to the declared manifest expansion — which itself must
+match the checked-in golden ``scripts/graftcheck_catalog.txt``. Ladder
+changes are therefore reviewed diffs: run ``--write-catalog`` and commit
+the golden alongside the PagedConfig change.
 
 The tier-1 suite runs this gate as
 ``tests/test_graftcheck.py::test_self_audit`` — no separate CI plumbing.
@@ -67,6 +78,7 @@ except Exception:
 
 from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import (  # noqa: E402
     GC_RULES,
+    Finding,
     audit_programs,
     check_collectives,
     check_fp32_widening,
@@ -76,9 +88,17 @@ from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import (  # noqa: E402
     read_baseline,
     write_baseline,
 )
+from neuronx_distributed_llama3_2_tpu.serving.catalog import (  # noqa: E402
+    format_key,
+    read_catalog_file,
+    write_catalog_file,
+)
 
 DEFAULT_BASELINE = os.path.join(
     REPO_ROOT, "scripts", "graftcheck_baseline.txt"
+)
+DEFAULT_CATALOG = os.path.join(
+    REPO_ROOT, "scripts", "graftcheck_catalog.txt"
 )
 
 _TINY = None
@@ -101,46 +121,6 @@ def _tiny():
         )
         _PARAMS = LlamaForCausalLM(_TINY).init(jax.random.key(0))
     return _TINY, _PARAMS
-
-
-def _engine(kv_cache_dtype="bf16", spec=0):
-    from neuronx_distributed_llama3_2_tpu.inference import (
-        GenerationConfig,
-        InferenceEngine,
-    )
-    from neuronx_distributed_llama3_2_tpu.serving import (
-        PagedConfig,
-        PagedServingEngine,
-    )
-
-    cfg, params = _tiny()
-    # the gate engines run with the graftscope flight recorder ON: the
-    # catalog checks (GC003 no host transfers in traces, GC006 fault-free
-    # program registry) then prove tracing never leaks into the programs
-    kw = dict(block_size=8, num_blocks=32, kv_cache_dtype=kv_cache_dtype,
-              trace_enabled=True, trace_buffer_steps=64)
-    if spec:
-        kw["spec_draft_tokens"] = spec
-    return PagedServingEngine(
-        InferenceEngine(
-            cfg, params, max_batch=4, max_seq_len=64, buckets=[8, 16]
-        ),
-        GenerationConfig(max_new_tokens=6),
-        PagedConfig(**kw),
-        precompile=False,
-    )
-
-
-def _run_and_audit(engine):
-    """Drive a couple of short requests through the engine so the real
-    program registry populates (prefill, decode, verify, lane_set,
-    table_delta scatters), then audit it."""
-    rng = np.random.default_rng(0)
-    cfg, _ = _tiny()
-    for n in (5, 7):
-        engine.submit(rng.integers(0, cfg.vocab_size, size=(n,)).tolist())
-    engine.run_to_completion()
-    return audit_programs(engine)
 
 
 def _decode_trace(model, params, b=4, kv_limit=32, nb=16, bs=8, w=8):
@@ -182,13 +162,171 @@ def _trace_rules(closed, name, model, b=4, kv_limit=32, quantized=False):
     return out
 
 
-def entry_engine():
-    """Spec-enabled int8 kernel engine: full registry audit — GC001-GC006
-    over pctx/pdecode/pverify and the lane_set/table_delta scatters as
-    actually compiled, GC005 over every program since the pool is
-    quantized. (bf16 engines get the same audit in every serving-suite
-    teardown; the gate runs the strictest single configuration.)"""
-    return _run_and_audit(_engine(kv_cache_dtype="int8", spec=4))
+def _catalog_engine(prewarm=True):
+    """The strictest single configuration the registry audit runs under:
+    int8 pool + speculative verify + chunked prefill + async lookahead,
+    prewarmed so the full manifest is compiled before first traffic."""
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    cfg, params = _tiny()
+    return PagedServingEngine(
+        InferenceEngine(
+            cfg, params, max_batch=4, max_seq_len=64, buckets=[8, 16]
+        ),
+        GenerationConfig(max_new_tokens=6),
+        PagedConfig(
+            block_size=8, num_blocks=32, kv_cache_dtype="int8",
+            spec_draft_tokens=4, prefill_chunk_tokens=6, async_loop=True,
+            trace_enabled=True, trace_buffer_steps=64, prewarm=prewarm,
+        ),
+        precompile=False,
+    )
+
+
+def _catalog_tp2_engine(prewarm=True):
+    """tp=2 catalog twin (caller owns the mesh): bf16 pool, chunked
+    prefill, single-bucket ladder — small enough that the 9-key manifest
+    compiles in seconds yet still proves the contract holds when the
+    programs are shard_mapped."""
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    cfg, params = _tiny()
+    return PagedServingEngine(
+        InferenceEngine(
+            cfg, params, max_batch=2, max_seq_len=16, buckets=[8]
+        ),
+        GenerationConfig(max_new_tokens=4),
+        PagedConfig(
+            block_size=8, num_blocks=16, prefill_chunk_tokens=3,
+            prewarm=prewarm,
+        ),
+        precompile=False,
+    )
+
+
+def _drive_mixed(engine, lens, seed=0):
+    """Deliberately heterogeneous traffic: prompt lengths straddling the
+    chunk size (whole-prefill and chunk-walk admissions), multiple
+    prefill buckets and kv rungs, spec verify if armed."""
+    cfg, _ = _tiny()
+    rng = np.random.default_rng(seed)
+    for n in lens:
+        engine.submit(rng.integers(0, cfg.vocab_size, size=(n,)).tolist())
+    engine.run_to_completion()
+
+
+def _catalog_drift(name, engine, catalog_path=DEFAULT_CATALOG):
+    """The GC007/GC008 gate arm: registry must equal the manifest
+    expansion exactly (both directions), and the manifest must equal the
+    checked-in golden entry. Returns findings in the same
+    baseline-filterable shape as the rule checkers."""
+    findings = []
+    label = f"gate:{name}"
+    reg = {format_key(k) for k in engine.program_registry()}
+    legal = {format_key(k) for k in engine.catalog.keys()}
+    for line in sorted(reg - legal):
+        findings.append(Finding(
+            rule="GC007", program=label,
+            message=f"registry key {line} is outside the manifest expansion",
+            hint="an out-of-ladder compile reached _register_program; widen "
+                 "the PagedConfig ladder or fix the dispatch padding",
+            detail=f"extra:{line}",
+        ))
+    for line in sorted(legal - reg):
+        findings.append(Finding(
+            rule="GC007", program=label,
+            message=f"manifest key {line} was never compiled "
+                    "(prewarm left a hole in the catalog)",
+            hint="prewarm() must cover every gather-free manifest key; "
+                 "check CatalogManifest.prewarm_keys() against the "
+                 "dispatch sites",
+            detail=f"missing:{line}",
+        ))
+    golden = read_catalog_file(catalog_path)
+    want = engine.catalog.lines()
+    if name not in golden:
+        findings.append(Finding(
+            rule="GC008", program=label,
+            message=f"no golden manifest entry '{name}' in {catalog_path}",
+            hint="run scripts/graftcheck_gate.py --write-catalog and commit "
+                 "the refreshed golden",
+            detail=f"golden-missing:{name}",
+        ))
+    elif golden[name] != want:
+        for line in sorted(set(want) - set(golden[name])):
+            findings.append(Finding(
+                rule="GC008", program=label,
+                message=f"manifest key {line} is not in the golden catalog "
+                        "(ladder grew without a reviewed golden refresh)",
+                hint="if the ladder change is intentional, run "
+                     "--write-catalog and commit the golden with a rationale",
+                detail=f"golden-add:{line}",
+            ))
+        for line in sorted(set(golden[name]) - set(want)):
+            findings.append(Finding(
+                rule="GC008", program=label,
+                message=f"golden catalog key {line} is no longer in the "
+                        "manifest (ladder shrank without a golden refresh)",
+                hint="if the ladder change is intentional, run "
+                     "--write-catalog and commit the golden with a rationale",
+                detail=f"golden-drop:{line}",
+            ))
+    return findings
+
+
+def entry_catalog():
+    """Prewarmed int8+spec+chunked+async engine under heterogeneous
+    traffic: full registry audit (GC001-GC008) plus the byte-identity
+    check registry == manifest == golden. Runs while no mesh is live."""
+    engine = _catalog_engine()
+    # lengths straddle chunk=6 (whole-prefill and chunk-walk), cross the
+    # 8/16 prefill buckets, and push positions across the kv rungs
+    _drive_mixed(engine, (3, 5, 7, 13, 20))
+    assert engine.metrics.steadystate_compiles == 0, (
+        "catalog engine compiled past the freeze: "
+        f"{engine.metrics.steadystate_compiles}"
+    )
+    return audit_programs(engine) + _catalog_drift("catalog-int8", engine)
+
+
+def entry_catalog_tp2():
+    """Same contract under a pure-tp=2 mesh: the prewarmed 9-key manifest
+    must bound the shard_mapped registry exactly."""
+    from neuronx_distributed_llama3_2_tpu.parallel.state import (
+        destroy_model_parallel,
+        initialize_model_parallel,
+    )
+
+    initialize_model_parallel(
+        tensor_model_parallel_size=2, devices=jax.devices()[:2]
+    )
+    try:
+        engine = _catalog_tp2_engine()
+        _drive_mixed(engine, (2, 5, 9))
+        assert engine.metrics.steadystate_compiles == 0, (
+            "tp2 catalog engine compiled past the freeze: "
+            f"{engine.metrics.steadystate_compiles}"
+        )
+        return (
+            audit_programs(engine)
+            + _catalog_drift("catalog-tp2", engine)
+        )
+    finally:
+        destroy_model_parallel()
 
 
 def entry_decode():
@@ -258,16 +396,17 @@ def entry_decode_tp2():
         destroy_model_parallel()
 
 
-# the program catalog: (name, thunk) -> findings. The engine entry runs
-# first (it must run while no mesh is live); the tp entry manages its own
-# mesh.
+# the program catalog: (name, thunk) -> findings. The catalog-int8 entry
+# runs first (it must run while no mesh is live); the tp entries manage
+# their own meshes, with catalog-tp2 last.
 CATALOG = (
-    ("engine-int8-spec", entry_engine),
+    ("catalog-int8", entry_catalog),
     ("decode", entry_decode),
     ("decode-int8", entry_decode_int8),
     ("verify-t1", entry_verify_t1),
     ("verify-t4", entry_verify_t4),
     ("decode-tp2", entry_decode_tp2),
+    ("catalog-tp2", entry_catalog_tp2),
 )
 
 
@@ -284,7 +423,59 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--list", action="store_true", help="list program-catalog entries"
     )
+    ap.add_argument("--catalog-file", default=DEFAULT_CATALOG)
+    ap.add_argument(
+        "--write-catalog", action="store_true",
+        help="rewrite the golden manifest from the declared ladders "
+             "(no compiles — the manifest is construction-time state)",
+    )
+    ap.add_argument(
+        "--catalog-diff", action="store_true",
+        help="print manifest-vs-registry-vs-golden drift for the "
+             "catalog-* entries and exit nonzero on any mismatch",
+    )
     args = ap.parse_args(argv)
+
+    if args.write_catalog:
+        # prewarm=False: the manifest is pure construction-time state, so
+        # refreshing the golden never waits on XLA
+        from neuronx_distributed_llama3_2_tpu.parallel.state import (
+            destroy_model_parallel,
+            initialize_model_parallel,
+        )
+
+        entries = {"catalog-int8": _catalog_engine(prewarm=False).catalog}
+        initialize_model_parallel(
+            tensor_model_parallel_size=2, devices=jax.devices()[:2]
+        )
+        try:
+            entries["catalog-tp2"] = _catalog_tp2_engine(
+                prewarm=False
+            ).catalog
+        finally:
+            destroy_model_parallel()
+        write_catalog_file(args.catalog_file, entries)
+        n = sum(len(m.lines()) for m in entries.values())
+        print(f"wrote {n} manifest key(s) to {args.catalog_file}")
+        return 0
+
+    if args.catalog_diff:
+        rc = 0
+        for name, fn in CATALOG:
+            if not name.startswith("catalog-"):
+                continue
+            got = [f for f in fn() if f.rule in ("GC007", "GC008")]
+            if not got:
+                print(f"{name}: registry == manifest == golden")
+                continue
+            rc = 1
+            for f in got:
+                sign = "-" if f.detail.startswith(
+                    ("missing:", "golden-drop:")
+                ) else "+"
+                print(f"{name}: {sign} {f.detail.split(':', 1)[1]}"
+                      f"  [{f.rule}]")
+        return rc
 
     if args.rules:
         for rule, summary in sorted(GC_RULES.items()):

@@ -162,8 +162,11 @@ def test_soak_randomized_schedule_token_identical(params):
     arrivals = np.sort(rng.integers(0, 190, size=n_requests)).tolist()
 
     def drive(async_loop):
+        # the async leg runs prewarmed: the whole catalog compiles before
+        # traffic and the soak must then compile NOTHING (GC008 freeze)
         paged = _paged(
-            params, gen, PagedConfig(**cfg, async_loop=async_loop),
+            params, gen,
+            PagedConfig(**cfg, async_loop=async_loop, prewarm=async_loop),
             max_seq_len=64, buckets=[8, 16, 32],
         )
         steps, next_req = 0, 0
@@ -190,6 +193,9 @@ def test_soak_randomized_schedule_token_identical(params):
     assert m.decode_steps_async > 0
     assert m.preemptions > 0  # the schedule actually exercised preemption
     assert m.prefill_chunks > 0  # ... and chunked prefill
+    # prewarmed leg: 200+ heterogeneous steps hit only prewarmed programs
+    assert m.prewarm_compiles > 0
+    assert m.steadystate_compiles == 0
 
 
 @pytest.mark.parametrize(

@@ -1,0 +1,301 @@
+"""Compiled-program catalog: ladder units, manifest expansion, prewarm.
+
+The contract under test (serving/catalog.py + PagedConfig.prewarm): a
+:class:`BucketLadder` declares every shape the engine may pad a dispatch
+into, :class:`CatalogManifest` expands ladder x variant flags into the
+exact legal ``_programs`` key set, ``prewarm=True`` compiles the whole
+manifest before traffic and freezes the registry — after which an
+arbitrarily heterogeneous workload must compile NOTHING
+(``metrics.steadystate_compiles == 0``, graftcheck GC008) and hold no
+key outside the manifest (GC007).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.analysis import graftcheck as gc
+from neuronx_distributed_llama3_2_tpu.inference import (
+    GenerationConfig,
+    InferenceEngine,
+)
+from neuronx_distributed_llama3_2_tpu.inference import engine as inf_engine
+from neuronx_distributed_llama3_2_tpu.inference.sampling import SamplingConfig
+from neuronx_distributed_llama3_2_tpu.models.llama import (
+    LLAMA_CONFIGS,
+    LlamaForCausalLM,
+)
+from neuronx_distributed_llama3_2_tpu.serving import (
+    PagedConfig,
+    PagedServingEngine,
+)
+from neuronx_distributed_llama3_2_tpu.serving import catalog as cat
+
+TINY = LLAMA_CONFIGS["tiny"]
+TINY_KERNEL = dataclasses.replace(TINY, use_paged_kernel=True)
+GREEDY = SamplingConfig()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return LlamaForCausalLM(TINY).init(jax.random.key(0))
+
+
+def _engine(params, *, prewarm=False, **paged_kw):
+    """Smallest real catalog: prefill/kv ladders both [8, 16]."""
+    return PagedServingEngine(
+        InferenceEngine(
+            TINY_KERNEL, params, max_batch=2, max_seq_len=16, buckets=[8],
+        ),
+        GenerationConfig(max_new_tokens=4),
+        PagedConfig(block_size=8, num_blocks=16, prewarm=prewarm, **paged_kw),
+        precompile=False,
+    )
+
+
+# ------------------------------------------------------------ ladder units
+
+
+def test_default_buckets_powers_of_two():
+    assert cat.default_buckets(64, min_bucket=8) == [8, 16, 32, 64]
+    assert cat.default_buckets(100, min_bucket=128) == [100]
+
+
+def test_pick_bucket_smallest_covering():
+    assert cat.pick_bucket([8, 16, 64], 1) == 8
+    assert cat.pick_bucket([8, 16, 64], 16) == 16
+    assert cat.pick_bucket([8, 16, 64], 17) == 64
+    with pytest.raises(ValueError, match="exceeds largest bucket"):
+        cat.pick_bucket([8, 16], 20)
+
+
+def test_inference_engine_reexports_delegate():
+    """The historical inference.engine import path must keep working and
+    agree with the canonical serving/catalog.py implementation."""
+    assert inf_engine.default_buckets(64, 8) == cat.default_buckets(64, 8)
+    assert inf_engine.pick_bucket([8, 16], 9) == cat.pick_bucket([8, 16], 9)
+
+
+def test_complete_ladder_appends_max_seq_len():
+    assert cat.complete_ladder([8], 64) == [8, 64]
+    assert cat.complete_ladder([8, 64], 64) == [8, 64]
+
+
+@pytest.mark.parametrize(
+    "buckets, msg",
+    [
+        ([], "must not be empty"),
+        ([0, 8], "must be positive"),
+        ([16, 8], "strictly ascending"),
+        ([8, 8], "strictly ascending"),
+        ([128], "exceeds max_seq_len"),
+    ],
+)
+def test_complete_ladder_rejects_malformed(buckets, msg):
+    with pytest.raises(ValueError, match=msg):
+        cat.complete_ladder(buckets, 64)
+
+
+def test_bucket_ladder_routing():
+    lad = cat.BucketLadder(
+        decode_batch=4, max_seq_len=64,
+        prefill_buckets=(8, 16, 64), kv_buckets=(8, 16, 64),
+    )
+    assert lad.kv_bucket(1) == 8
+    assert lad.kv_bucket(8) == 8
+    assert lad.kv_bucket(9) == 16
+    assert lad.kv_bucket(65) == 64  # clamped to the full cache
+    assert lad.prefill_bucket(0) == 8  # empty suffix still pads to a rung
+    assert lad.prefill_bucket(17) == 64
+
+
+def test_suffix_pairs_reachable_kv_limits_only():
+    """psfx carries kv_limit = kv_bucket(min(cached + bucket, max)) with
+    cached >= 1 — rungs below that floor are unreachable and must not be
+    in the manifest (they would be dead prewarmed programs)."""
+    lad = cat.BucketLadder(
+        decode_batch=4, max_seq_len=64,
+        prefill_buckets=(8, 16, 64), kv_buckets=(8, 16, 64),
+    )
+    assert lad.suffix_pairs() == [(8, 16), (8, 64), (16, 64), (64, 64)]
+
+
+# ------------------------------------------------------ manifest expansion
+
+
+def test_manifest_expansion_hand_checked(params):
+    eng = _engine(params)
+    assert eng.catalog.keys() == {
+        ("copy_block", False), ("lane_set",), ("table_delta",),
+        ("pctx", 8, GREEDY, False), ("pctx", 16, GREEDY, False),
+        ("psfx", 8, 16, GREEDY, False), ("psfx", 16, 16, GREEDY, False),
+        ("pdecode", GREEDY, 8, False, False),
+        ("pdecode", GREEDY, 16, False, False),
+    }
+
+
+def test_manifest_spec_adds_verify_widths(params):
+    eng = _engine(params, spec_draft_tokens=2)
+    extra = eng.catalog.keys() - _engine(params).catalog.keys()
+    assert extra == {
+        ("pverify", 8, 2, False, False), ("pverify", 16, 2, False, False),
+    }
+
+
+def test_manifest_gather_variants_legal_but_not_prewarmed(params):
+    """degrade_after_faults arms the kernel-shed ladder: gather twins
+    become LEGAL keys (GC007) but prewarm never compiles them (GC006
+    forbids gather programs on a never-degraded engine)."""
+    eng = _engine(params, degrade_after_faults=1)
+    keys = eng.catalog.keys()
+    assert ("pdecode", GREEDY, 8, True, False) in keys
+    base = {k for k in keys if not _is_gather(k)}
+    assert base == _engine(params).catalog.keys()
+    warm = eng.catalog.prewarm_keys()
+    assert not any(_is_gather(k) for k in warm)
+    assert set(warm) == base
+
+
+def _is_gather(key):
+    kind = key[0]
+    if kind in ("pctx", "psfx"):
+        return key[-1]
+    if kind in ("pdecode", "pverify"):
+        return key[-2]
+    return False
+
+
+def test_ladder_override_knobs(params):
+    eng = _engine(params, kv_buckets=(4, 16), prefill_buckets=(8,))
+    assert eng._kv_buckets == [4, 16]
+    assert eng._prefill_buckets == [8, 16]
+    assert eng.catalog.ladder.kv_buckets == (4, 16)
+    assert eng.catalog.ladder.prefill_buckets == (8, 16)
+
+
+def test_catalog_describe_mentions_size(params):
+    eng = _engine(params)
+    assert f"{len(eng.catalog.keys())} keys" in eng.catalog.describe()
+
+
+# ----------------------------------------------------------- key rendering
+
+
+def test_format_key_house_style():
+    assert cat.format_key(("lane_set",)) == "lane_set"
+    assert cat.format_key(("copy_block", True)) == "copy_block[quantized=True]"
+    assert (
+        cat.format_key(("pdecode", GREEDY, 16, False, False))
+        == "pdecode[kv_limit=16,cfg=greedy]"
+    )
+    assert (
+        cat.format_key(("pdecode", GREEDY, 16, True, True))
+        == "pdecode[kv_limit=16,cfg=greedy,gather,checked]"
+    )
+    assert (
+        cat.format_key(("pverify", 16, 4, False, False))
+        == "pverify[kv_limit=16,k=4]"
+    )
+    sampled = SamplingConfig(greedy=False, temperature=0.8, top_k=40)
+    assert (
+        cat.format_key(("psfx", 8, 16, sampled, False))
+        == "psfx[bucket=8,kv_limit=16,cfg=T0.8-k40]"
+    )
+
+
+def test_nearest_key_ranks_by_bucket_distance(params):
+    legal = _engine(params).catalog.keys()
+    near = cat.nearest_key(("pdecode", GREEDY, 13, False, False), legal)
+    assert near == "pdecode[kv_limit=16,cfg=greedy]"
+    assert cat.nearest_key(("no_such_kind", 3), legal) is None
+
+
+def test_catalog_file_roundtrip(tmp_path, params):
+    path = str(tmp_path / "catalog.txt")
+    manifest = _engine(params).catalog
+    cat.write_catalog_file(path, {"a": manifest, "b": ["lane_set"]})
+    back = cat.read_catalog_file(path)
+    assert back == {"a": manifest.lines(), "b": ["lane_set"]}
+    assert cat.read_catalog_file(str(tmp_path / "missing.txt")) == {}
+
+
+def test_validate_ladder_flags_oversize_verify_width():
+    class _Model:
+        def paged_dispatch_path(self, t, tree=None):
+            return "kernel" if t <= 4 else "gather"
+
+    lad = cat.BucketLadder(
+        decode_batch=4, max_seq_len=64,
+        prefill_buckets=(8,), kv_buckets=(8,), verify_t=(8,),
+    )
+    (warning,) = cat.validate_ladder(_Model(), lad)
+    assert "verify_t=8" in warning
+    ok = dataclasses.replace(lad, verify_t=(3,))
+    assert cat.validate_ladder(_Model(), ok) == []
+    assert cat.validate_ladder(object(), lad) == []  # duck-typed: no hook
+
+
+# ------------------------------------------------------- prewarm contract
+
+
+def test_prewarm_compiles_exactly_the_manifest(params):
+    eng = _engine(params, prewarm=True)
+    assert set(eng.program_registry()) == eng.catalog.keys()
+    assert eng.metrics.programs_compiled == len(eng.catalog.keys())
+    assert eng.metrics.steadystate_compiles == 0
+    # every program actually dispatched during prewarm (avals recorded),
+    # so the full registry is auditable and lower()-able
+    assert all(
+        rec.example_args is not None
+        for rec in eng.program_registry().values()
+    )
+    assert eng._frozen_keys == frozenset(eng.program_registry())
+    assert gc.audit_programs(eng) == []
+
+
+def test_prewarm_keeps_uploads_at_zero(params):
+    """Prewarm feeds programs device-constructed arrays — it must not
+    count as host->device traffic (h2d_uploads is a serving SLO)."""
+    eng = _engine(params, prewarm=True)
+    assert eng.metrics.h2d_uploads == 0
+
+
+def test_first_request_hits_only_prewarmed_programs(params):
+    eng = _engine(params, prewarm=True)
+    before = eng.metrics.programs_compiled
+    eng.submit([1, 2, 3, 4, 5])
+    out = eng.run_to_completion()
+    assert len(out[0]) == 4
+    assert eng.metrics.programs_compiled == before
+    assert eng.metrics.steadystate_compiles == 0
+
+
+def test_frozen_registry_across_mixed_workload(params):
+    """Heterogeneous traffic (every prompt length a different pad) on a
+    prewarmed engine compiles nothing: the registry stays byte-identical
+    to the manifest and GC007/GC008 stay quiet."""
+    eng = _engine(params, prewarm=True)
+    frozen = set(eng.program_registry())
+    rng = np.random.default_rng(7)
+    for wave in ((2, 5), (7, 11), (3, 9), (1, 10)):
+        for n in wave:
+            eng.submit(
+                rng.integers(0, TINY.vocab_size, size=(n,)).tolist()
+            )
+        eng.run_to_completion()
+    assert eng.metrics.finished == 8
+    assert eng.metrics.decode_steps >= 12
+    assert set(eng.program_registry()) == frozen == eng.catalog.keys()
+    assert eng.metrics.steadystate_compiles == 0
+    assert gc.audit_programs(eng) == []
+
+
+def test_out_of_catalog_compile_is_caught(params):
+    """The smuggle case the whole contract exists for: a compile the
+    ladder does not cover fires GC007 (and, post-freeze, GC008)."""
+    eng = _engine(params, prewarm=True)
+    eng._decode_program(eng.gen.sampling, 12)  # no such rung
+    rules = sorted(f.rule for f in gc.audit_programs(eng))
+    assert rules == ["GC007", "GC008"]

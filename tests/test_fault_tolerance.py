@@ -536,12 +536,15 @@ def _chaos_soak(params, n_requests, arrival_span, max_new, plan, workload_seed,
                 repeat_chaos=False):
     rng = np.random.default_rng(workload_seed)
     gen = GenerationConfig(max_new_tokens=max_new)
+    # chaos drives run prewarmed: after the catalog freeze the only legal
+    # mid-traffic compiles are the degradation ladder's gather twins
+    # (exempt from steadystate_compiles / GC008)
     cfg = PagedConfig(
         block_size=4, num_blocks=24, decode_reserve_blocks=1,
         prefill_chunk_tokens=8, async_loop=True, spec_draft_tokens=4,
         stall_step_limit=300, audit_interval=8, audit_debug=True,
         degrade_after_faults=3, degrade_window_steps=32,
-        degrade_recover_steps=16,
+        degrade_recover_steps=16, prewarm=True,
     )
     lengths = rng.integers(3, 32, size=n_requests)
     prompts = []
@@ -559,7 +562,9 @@ def _chaos_soak(params, n_requests, arrival_span, max_new, plan, workload_seed,
         paged = _paged(
             params, gen,
             cfg if injector is not None
-            else dataclasses.replace(cfg, audit_interval=0, audit_debug=False),
+            else dataclasses.replace(
+                cfg, audit_interval=0, audit_debug=False, prewarm=False,
+            ),
             injector=injector,
         )
         steps, next_req, alive = 0, 0, True
@@ -592,6 +597,10 @@ def _check_soak(chaos, base_out, plan):
     assert m.faults_injected == inj.total_fired
     assert m.failed_requests == n_failed
     assert m.audit_violations == 0  # strict audits ran at every transition
+    # prewarmed catalog held through the whole chaos run: nothing but
+    # ladder-sanctioned gather twins compiled after the freeze
+    assert m.prewarm_compiles > 0
+    assert m.steadystate_compiles == 0
     # reproducibility: the same plan over the same workload fires the same
     # faults — (workload seed, FaultPlan) fully determines a chaos run
     return [f[:3] for f in inj.fired]

@@ -221,9 +221,12 @@ def test_gc006_fires_on_checked_program_in_fault_free_engine(params):
     eng._check_logits = True
     eng._decode_program(eng.gen.sampling, 16)
     eng._check_logits = False
+    # the smuggled variant is impure (GC006) AND, since the manifest
+    # mirrors the engine's real checked bit, out-of-catalog (GC007)
     fs = gc.audit_programs(eng)
-    assert [f.rule for f in fs] == ["GC006"]
-    assert fs[0].detail == "checked"
+    assert sorted(f.rule for f in fs) == ["GC006", "GC007"]
+    (f6,) = [f for f in fs if f.rule == "GC006"]
+    assert f6.detail == "checked"
 
 
 def test_gc006_fires_on_gather_program_in_undegraded_engine(params):
@@ -232,9 +235,13 @@ def test_gc006_fires_on_gather_program_in_undegraded_engine(params):
     eng._decode_program(eng.gen.sampling, 16)
     eng._degrade_level = 0
     assert eng.metrics.degradations == 0
+    # gather twins are only catalog-legal when the ladder is armed
+    # (degrade_after_faults > 0) — on this engine the smuggle is both
+    # impure (GC006) and out-of-catalog (GC007)
     fs = gc.audit_programs(eng)
-    assert [f.rule for f in fs] == ["GC006"]
-    assert fs[0].detail == "gather"
+    assert sorted(f.rule for f in fs) == ["GC006", "GC007"]
+    (f6,) = [f for f in fs if f.rule == "GC006"]
+    assert f6.detail == "gather"
 
 
 def test_gc006_quiet_when_fault_config_legitimizes_checked(params):
@@ -268,6 +275,79 @@ def test_program_registry_records_metadata(params):
         rec.lower()
     # the registry returns the same record for the same key
     assert eng._decode_program(eng.gen.sampling, 16) is rec
+
+
+# ------------------------------------------------- GC007 / GC008 catalog
+
+
+def test_gc007_fires_on_out_of_catalog_key(params):
+    """A program key whose kv_limit is not a declared ladder rung is an
+    out-of-catalog compile; the finding names the nearest legal bucket."""
+    eng = _quiet_engine(params)
+    assert gc.audit_programs(eng) == []
+    eng._decode_program(eng.gen.sampling, 13)  # 13 is no rung of [8,16,64]
+    fs = gc.audit_programs(eng)
+    assert [f.rule for f in fs] == ["GC007"]
+    assert "kv_limit=13" in fs[0].message
+    assert "pdecode[kv_limit=16" in fs[0].message  # nearest bucket named
+
+
+def test_gc007_quiet_on_manifest_keys_and_suppressable(params):
+    eng = _quiet_engine(params)
+    eng._decode_program(eng.gen.sampling, 64)  # legal rung: quiet
+    assert gc.audit_programs(eng) == []
+    eng._decode_program(eng.gen.sampling, 13)
+    assert gc.audit_programs(eng, suppress={"GC007"}) == []
+
+
+def test_gc008_fires_on_post_freeze_registry_growth(params):
+    """A key compiled after mark_steady() is flagged even when it IS in
+    the manifest — the freeze is about recompile stalls, not legality."""
+    eng = _quiet_engine(params)
+    rng = np.random.default_rng(0)
+    for n in (5, 9):
+        eng.submit(rng.integers(0, TINY.vocab_size, size=(n,)).tolist())
+    eng.run_to_completion()
+    eng.mark_steady()
+    assert gc.audit_programs(eng) == []
+    eng._decode_program(eng.gen.sampling, 64)  # legal but post-freeze
+    fs = gc.audit_programs(eng)
+    assert [f.rule for f in fs] == ["GC008"]
+    assert fs[0].detail.startswith("new:")
+
+
+def test_gc008_fires_on_post_freeze_relower(params):
+    """Re-dispatching a frozen program at different avals grows its jit
+    trace cache — the static twin of a mid-traffic recompile stall."""
+    eng = _quiet_engine(params)
+    rng = np.random.default_rng(0)
+    eng.submit(rng.integers(0, TINY.vocab_size, size=(5,)).tolist())
+    eng.run_to_completion()
+    eng.mark_steady()
+    assert gc.audit_programs(eng) == []
+    rec = eng.program_registry()[("lane_set",)]
+    # engine dispatches (4,) lanes; (8,) forces a second trace (donated
+    # args must be distinct buffers)
+    rec.jitted(
+        jnp.zeros((8,), jnp.int32), jnp.zeros((8,), jnp.int32),
+        jnp.zeros((8, eng.table_width), jnp.int32),
+        jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+        jnp.asarray(0, jnp.int32),
+        jnp.zeros((eng.table_width,), jnp.int32),
+    )
+    fs = gc.audit_programs(eng)
+    assert [f.rule for f in fs] == ["GC008"]
+    assert fs[0].detail.startswith("relower:")
+    assert "lane_set" in fs[0].program
+
+
+def test_gc008_quiet_before_freeze(params):
+    """Engines that never mark_steady() (no prewarm) are exempt — GC008
+    is a steady-state contract, not a construction-time one."""
+    eng = _quiet_engine(params)
+    eng._decode_program(eng.gen.sampling, 64)
+    assert eng._frozen_keys is None
+    assert gc.audit_programs(eng) == []
 
 
 # ----------------------------------------------------------- machinery
@@ -329,6 +409,7 @@ def test_fingerprint_is_stable_and_detail_keyed():
 def test_rule_catalogue_complete():
     assert sorted(gc.GC_RULES) == [
         "GC001", "GC002", "GC003", "GC004", "GC005", "GC006",
+        "GC007", "GC008",
     ]
 
 

@@ -226,7 +226,8 @@ EXPECTED_SNAPSHOT_KEYS = {
     "accepted_tokens", "verify_steps", "spec_disabled_lanes",
     "faults_injected", "failed_requests", "lane_quarantines",
     "drafter_faults", "degradation_level", "degradations",
-    "audit_violations",
+    "audit_violations", "programs_compiled", "prewarm_compiles",
+    "steadystate_compiles",
     # derived
     "prefix_skip_fraction", "accept_rate", "host_schedule_ms_per_step",
     "device_wait_ms_per_step",
@@ -479,3 +480,33 @@ def test_mixed_soak_exports_valid_chrome_trace(params, tmp_path):
     jl = paged.export_trace(str(tmp_path / "soak_trace.jsonl"), fmt="jsonl")
     with open(jl) as f:
         assert len([json.loads(ln) for ln in f]) == len(evs)
+
+
+def test_trace_events_tag_padded_bucket(params):
+    """Every dispatch slice names the kv rung it padded into (and the pad
+    waste), every prefill slice its token bucket — the flight-recorder
+    view of the catalog ladder (docs/serving.md 'Compiled-program
+    catalog'), so an out-of-ladder shape is visible in the trace too."""
+    gen = GenerationConfig(max_new_tokens=6)
+    paged = _paged(
+        params, gen,
+        PagedConfig(block_size=8, num_blocks=64, trace_enabled=True,
+                    trace_buffer_steps=64, prefill_chunk_tokens=6),
+        TINY_KERNEL,
+    )
+    for p in _prompts(np.random.default_rng(5), (4, 9)):
+        paged.submit(p)
+    paged.run_to_completion()
+    evs = paged.tracer.chrome_events()
+    dispatches = [e for e in evs if e["name"] == "dispatch"]
+    assert dispatches
+    for e in dispatches:
+        bucket, pad = e["args"]["kv_bucket"], e["args"]["kv_pad"]
+        assert bucket in paged._kv_buckets
+        assert 0 <= pad < bucket
+    prefills = [e for e in evs if e["name"] in ("prefill", "prefill_chunk")]
+    assert {e["name"] for e in prefills} == {"prefill", "prefill_chunk"}
+    for e in prefills:
+        bucket, pad = e["args"]["bucket"], e["args"]["pad"]
+        assert bucket in paged._prefill_buckets
+        assert 0 <= pad < bucket
