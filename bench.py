@@ -39,7 +39,8 @@ def main():
         initialize_parallel_model,
         make_train_step,
     )
-    from neuronx_distributed_llama3_2_tpu.trainer.metrics import (
+    from neuronx_distributed_llama3_2_tpu.flops import (
+        PEAK_FLOPS_PER_CHIP,
         mfu,
         train_flops_per_token,
     )
@@ -101,8 +102,8 @@ def main():
     tokens_per_sec = batch * seq / dt
 
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(state.params))
-    # v5e: 197 TFLOP/s bf16 peak
-    peak = 197e12
+    # v5e bf16 peak (flops.py — shared with the serving CostProfiles)
+    peak = PEAK_FLOPS_PER_CHIP
     measured_mfu = mfu(
         tokens_per_sec,
         n_params,
@@ -129,6 +130,10 @@ def main():
                     "batch": batch,
                     "seq": seq,
                     "n_params": n_params,
+                    "flops_per_token": train_flops_per_token(
+                        n_params, model_cfg.num_layers,
+                        model_cfg.hidden_size, seq,
+                    ),
                     "chip": str(jax.devices()[0]),
                 },
             }

@@ -99,6 +99,7 @@ GC_RULES: Dict[str, str] = {
     "GC006": "fault-free engine compiled a checked/gather program variant",
     "GC007": "program key not derivable from the declared catalog manifest",
     "GC008": "registry grew or a key re-lowered after the steady-state freeze",
+    "GC009": "cost-accounting engine holds a key without a usable CostProfile",
 }
 
 #: default axis universe for GC004 — kept in sync with parallel/state.py
@@ -765,4 +766,64 @@ def audit_programs(
                 findings.extend(
                     check_no_gather(closed, forbidden, label, suppress=suppress)
                 )
+    if "GC009" not in suppress:
+        findings.extend(_check_cost_profiles(engine, frozen))
+    return findings
+
+
+def _check_cost_profiles(engine: Any, frozen) -> List[Finding]:
+    """GC009 — cost-profile completeness (graftmeter, serving/accounting):
+    once a frozen engine has harvested (``cost_profiles`` is not None),
+    every registry key must carry a :class:`CostProfile` with positive
+    FLOPs (compute kinds report model FLOPs; move kinds elements moved)
+    and positive argument bytes. A missing or degenerate profile means
+    the MFU/roofline figures downstream silently undercount."""
+    profiles = getattr(engine, "cost_profiles", None)
+    if profiles is None or frozen is None:
+        return []
+    findings: List[Finding] = []
+    for key, rec in engine.program_registry().items():
+        label = _registry_label(rec)
+        prof = profiles.get(key)
+        if prof is None:
+            findings.append(
+                Finding(
+                    rule="GC009",
+                    program=label,
+                    message=(
+                        "no CostProfile for a registered program on a "
+                        "cost-accounting engine"
+                    ),
+                    hint=(
+                        "ensure_cost_profiles() runs at the end of "
+                        "prewarm(); a key compiled after harvest needs a "
+                        "re-harvest (or is itself a GC008 finding)"
+                    ),
+                    detail="missing",
+                )
+            )
+            continue
+        bad = []
+        if not prof.flops > 0:
+            bad.append(f"flops={prof.flops}")
+        if not prof.argument_bytes > 0:
+            bad.append(f"argument_bytes={prof.argument_bytes}")
+        if bad:
+            findings.append(
+                Finding(
+                    rule="GC009",
+                    program=label,
+                    message=(
+                        "degenerate CostProfile ("
+                        + ", ".join(bad)
+                        + ") — MFU/roofline accounting would undercount"
+                    ),
+                    hint=(
+                        "check serving/accounting.py analytic_cost for "
+                        "this program kind and the harvested lowering's "
+                        "cost_analysis()"
+                    ),
+                    detail=prof.flops_source,
+                )
+            )
     return findings

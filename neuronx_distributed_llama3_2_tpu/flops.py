@@ -1,0 +1,75 @@
+"""Shared model-FLOP arithmetic: ONE formula for train- and serve-side MFU.
+
+Historically the training estimator lived in ``trainer/metrics.py``
+(consumed by ``bench.py`` and ``scripts/mfu_sweep.py``) while the serving
+engine had no FLOP model at all. graftmeter (docs/serving.md "Cost
+accounting & SLOs") needs a serve-side estimate for its analytic
+CostProfile fallback, so the formula moves here and both sides import it
+— train-side MFU and the serving roofline can never drift apart again.
+
+The model: a forward pass costs ``2·N`` matmul FLOPs per token plus the
+attention term ``4·L·H·K`` at context length ``K`` (two batched matmuls,
+QKᵀ and attn·V, each ``2·H·K`` per layer). Training multiplies by 3 for
+the backward pass, recovering the classic ``6·N + 12·L·H·S`` — exactly
+the expression ``trainer/metrics.py`` always used, verified drift-free
+when this module was factored out.
+
+Peak figures are the v5e reference chip (the BASELINE.md target
+hardware); callers may override per-chip peaks explicitly.
+"""
+
+from __future__ import annotations
+
+# TPU v5e reference peaks: bf16 matmul throughput, HBM capacity and
+# bandwidth. bench.py's 45%-MFU north star and the serving roofline
+# both normalize by these.
+PEAK_FLOPS_PER_CHIP = 197e12        # bf16 FLOP/s
+HBM_BYTES_PER_CHIP = 16 * 2**30     # 16 GiB HBM
+PEAK_HBM_BW_PER_CHIP = 819e9        # bytes/s
+
+
+def model_flops_per_token(
+    num_params: int,
+    num_layers: int,
+    hidden_size: int,
+    context_len: int,
+    backward: bool = False,
+) -> float:
+    """Per-token model FLOPs at attention context ``context_len``:
+    ``2·N + 4·L·H·K`` forward, ×3 with the backward pass."""
+    fwd = 2 * num_params + 4 * num_layers * hidden_size * context_len
+    return 3.0 * fwd if backward else float(fwd)
+
+
+def train_flops_per_token(
+    num_params: int, num_layers: int, hidden_size: int, seq_len: int
+) -> float:
+    """Per-token training FLOPs (``6·N + 12·L·H·S``). Single source of
+    truth for MFU and bench targets — re-exported by trainer/metrics.py."""
+    return model_flops_per_token(
+        num_params, num_layers, hidden_size, seq_len, backward=True
+    )
+
+
+def decode_flops_per_token(
+    num_params: int, num_layers: int, hidden_size: int, kv_len: int
+) -> float:
+    """Per-token decode FLOPs at kv context ``kv_len`` — the serving-side
+    twin of :func:`train_flops_per_token` (forward only)."""
+    return model_flops_per_token(num_params, num_layers, hidden_size, kv_len)
+
+
+def mfu(
+    tokens_per_sec: float,
+    num_params: int,
+    num_layers: int,
+    hidden_size: int,
+    seq_len: int,
+    peak_flops_per_chip: float,
+    num_chips: int = 1,
+) -> float:
+    """Model FLOPs utilization (training convention)."""
+    achieved = tokens_per_sec * train_flops_per_token(
+        num_params, num_layers, hidden_size, seq_len
+    )
+    return achieved / (peak_flops_per_chip * num_chips)

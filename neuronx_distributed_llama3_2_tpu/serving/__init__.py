@@ -8,6 +8,14 @@ lives in :mod:`.faults` / :mod:`.invariants` — see docs/serving.md
 "Failure handling & degradation".
 """
 
+from neuronx_distributed_llama3_2_tpu.serving.accounting import (
+    CostProfile,
+    HBMLedger,
+    analytic_profiles,
+    cost_table_lines,
+    harvest_cost_profiles,
+    hbm_ledger,
+)
 from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     NULL_BLOCK,
     AllocatorError,
@@ -46,6 +54,10 @@ from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
     RadixPrefixIndex,
 )
+from neuronx_distributed_llama3_2_tpu.serving.slo import (
+    SLOMonitor,
+    SLOPolicy,
+)
 from neuronx_distributed_llama3_2_tpu.serving.tracing import (
     EngineTracer,
     program_label,
@@ -58,11 +70,13 @@ __all__ = [
     "BlockAllocator",
     "BucketLadder",
     "CatalogManifest",
+    "CostProfile",
     "DraftProposer",
     "EngineStalledError",
     "EngineTracer",
     "FaultInjector",
     "FaultPlan",
+    "HBMLedger",
     "Histogram",
     "InjectedFault",
     "InvariantViolation",
@@ -70,10 +84,16 @@ __all__ = [
     "PagedConfig",
     "PagedServingEngine",
     "RadixPrefixIndex",
+    "SLOMonitor",
+    "SLOPolicy",
     "ServingMetrics",
+    "analytic_profiles",
     "audit_engine",
+    "cost_table_lines",
     "default_buckets",
     "format_key",
+    "harvest_cost_profiles",
+    "hbm_ledger",
     "make_serving_engine",
     "pick_bucket",
     "program_label",

@@ -69,6 +69,7 @@ from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     BlockAllocator,
 )
 from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
+from neuronx_distributed_llama3_2_tpu.serving.slo import SLOMonitor, SLOPolicy
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
     RadixPrefixIndex,
 )
@@ -246,6 +247,25 @@ class PagedConfig:
     # turn any out-of-catalog or post-freeze compile into a finding.
     # Supersedes the precompile flag's partial warmup.
     prewarm: bool = False
+    # -- graftmeter: device-cost ledger + SLO burn-rate alerts
+    #    (docs/serving.md "Cost accounting & SLOs"; serving/accounting.py,
+    #    serving/slo.py) --
+    # harvest per-program CostProfiles + the HBM ledger at the end of
+    # prewarm() (static, host-only; never touches the dispatch path)
+    cost_accounting: bool = True
+    # override the per-chip HBM budget the ledger headrooms against
+    # (None = device memory_stats()["bytes_limit"], else a 16 GiB default)
+    hbm_budget_bytes: Optional[int] = None
+    # latency objectives: p99 targets in milliseconds; None = objective
+    # not declared. With neither set, the SLO monitor is never built.
+    slo_ttft_p99_ms: Optional[float] = None
+    slo_tpot_p99_ms: Optional[float] = None
+    slo_eval_steps: int = 16       # engine steps between burn evaluations
+    slo_burn_window: int = 4       # evaluations per rolling burn window
+    slo_burn_threshold: float = 1.0  # windowed burn that raises an alert
+    # sustained burn feeds the PR 8 degradation ladder through the same
+    # _note_event funnel chaos faults use (ladder knobs must also be on)
+    slo_degrade: bool = False
 
 
 @dataclasses.dataclass
@@ -545,6 +565,26 @@ class PagedServingEngine:
             ("copy_block", self._kv_quantized), _copy_block,
             donate_argnums=(0,), kind="copy_block",
         )
+        # graftmeter device-cost ledger (serving/accounting.py): filled by
+        # ensure_cost_profiles() — automatically at the end of prewarm()
+        # when cost_accounting is on. _flops_by_key caches (flops, bytes)
+        # per COMPUTE program key so the per-dispatch meter fold is two
+        # float adds off a dict hit; move programs are profiled but never
+        # counted into dispatched_flops (their "flops" are elements moved).
+        self.cost_profiles: Optional[Dict[tuple, Any]] = None
+        self.hbm: Optional[Any] = None
+        self._flops_by_key: Dict[tuple, tuple] = {}
+        from neuronx_distributed_llama3_2_tpu import flops as _flops_mod
+
+        self.metrics.peak_flops_per_chip = _flops_mod.PEAK_FLOPS_PER_CHIP
+        self.metrics.peak_hbm_bw_per_chip = _flops_mod.PEAK_HBM_BW_PER_CHIP
+        # SLO burn-rate monitor (serving/slo.py): built only when an
+        # objective is declared; otherwise the step hook is a None test
+        slo_policy = SLOPolicy.from_paged(paged)
+        self._slo: Optional[SLOMonitor] = (
+            SLOMonitor(slo_policy, self.metrics) if slo_policy.active
+            else None
+        )
         if paged.prewarm:
             self.prewarm()
         elif precompile:
@@ -600,6 +640,59 @@ class PagedServingEngine:
         static for the engine's lifetime; ``catalog.keys()`` is the GC007
         legality universe for :meth:`program_registry`."""
         return self.catalog
+
+    def ensure_cost_profiles(self, deep: bool = False) -> Dict[tuple, Any]:
+        """graftmeter harvest (serving/accounting.py): build per-program
+        :class:`CostProfile`\\ s from every registered ``ProgramRecord``
+        (XLA ``cost_analysis`` where a lowering exists, analytic formulas
+        otherwise), the HBM ledger, the per-rung roofline table, and the
+        per-key FLOP cache the dispatch meter folds from. Pure host work;
+        runs automatically at the end of :meth:`prewarm` when
+        ``PagedConfig.cost_accounting`` is on. ``deep=True`` additionally
+        compiles each lowering for XLA ``temp_size_in_bytes`` (expensive —
+        offline analysis only). Idempotent per (deep,) flavor."""
+        from neuronx_distributed_llama3_2_tpu.serving.accounting import (
+            COMPUTE_KINDS,
+            harvest_cost_profiles,
+            hbm_ledger,
+        )
+
+        profiles = harvest_cost_profiles(self, deep=deep)
+        self.cost_profiles = profiles
+        self._flops_by_key = {
+            k: (p.flops, p.bytes_accessed)
+            for k, p in profiles.items()
+            if p.kind in COMPUTE_KINDS
+        }
+        ledger = hbm_ledger(
+            self, profiles=profiles,
+            budget_bytes=self.paged.hbm_budget_bytes,
+        )
+        self.hbm = ledger
+        m = self.metrics
+        m.cost_profiled_programs = len(profiles)
+        m.hbm_budget_bytes = ledger.budget_bytes
+        m.hbm_footprint_bytes = ledger.footprint_bytes
+        m.hbm_headroom_bytes = ledger.headroom_bytes
+        # per-rung roofline ceilings from the plain (non-gather, unchecked)
+        # decode profile of each kv rung: what MFU the memory system allows
+        # a decode dispatch at that attention extent
+        peak_flops = m.peak_flops_per_chip * max(m.tp_size, 1)
+        peak_bw = m.peak_hbm_bw_per_chip * max(m.tp_size, 1)
+        by_rung: Dict[int, dict] = {}
+        for key_, p in profiles.items():
+            if p.kind != "pdecode" or key_[3] or key_[4]:
+                continue
+            rung = int(key_[2])
+            by_rung[rung] = {
+                "flops": p.flops,
+                "bytes": p.bytes_accessed,
+                "arithmetic_intensity": round(p.arithmetic_intensity(), 6),
+                "roofline_mfu": round(
+                    p.roofline_mfu(peak_flops, peak_bw), 6),
+            }
+        m.mfu_by_rung = by_rung
+        return profiles
 
     def _kv_bucket(self, needed: int) -> int:
         """kv_limit rung covering ``needed`` rows over the serving kv
@@ -1238,6 +1331,10 @@ class PagedServingEngine:
         finally:
             self._prewarming = False
         self.mark_steady()
+        if self.paged.cost_accounting:
+            # graftmeter: every catalog key just compiled — harvest the
+            # device-cost ledger while the lowerings are trace-cache warm
+            self.ensure_cost_profiles()
 
     # -- request lifecycle -------------------------------------------------
 
@@ -1442,6 +1539,12 @@ class PagedServingEngine:
                 self._upload(np.asarray([cached], np.int32)),
                 self._upload(length), table_dev, key,
             )
+        # graftmeter pad-waste fold: every prefill (admission or chunk)
+        # funnels through here with `fn` bound to the dispatched program
+        self.metrics.note_prefill_dispatch(
+            bucket, max(len(suffix), 1),
+            *(self._flops_by_key.get(fn.key) or (0.0, 0.0)),
+        )
         return int(self._read_tokens(tok)[0])
 
     def _advance_prefills(self) -> None:
@@ -1774,6 +1877,10 @@ class PagedServingEngine:
         kv_need = int(max(self._positions[l] for l in decode_lanes)) + 1
         kv_limit = self._kv_bucket(kv_need)
         fn = self._decode_program(self.gen.sampling, kv_limit)
+        self.metrics.note_decode_dispatch(
+            kv_limit, kv_need,
+            *(self._flops_by_key.get(fn.key) or (0.0, 0.0)),
+        )
         self._key, k = jax.random.split(self._key)
         tr = self.tracer
         t_d = tr.now() if tr.enabled else 0.0
@@ -1836,6 +1943,10 @@ class PagedServingEngine:
         kv_need = int(max(self._positions[l] for l in decode_lanes)) + 1
         kv_limit = self._kv_bucket(kv_need)
         fn = self._decode_program(self.gen.sampling, kv_limit)
+        self.metrics.note_decode_dispatch(
+            kv_limit, kv_need,
+            *(self._flops_by_key.get(fn.key) or (0.0, 0.0)),
+        )
         self._key, k = jax.random.split(self._key)
         tr = self.tracer
         t_d = tr.now() if tr.enabled else 0.0
@@ -1969,6 +2080,10 @@ class PagedServingEngine:
         kv_need = int(max(self._positions[l] for l in decode_lanes)) + k + 1
         kv_limit = self._kv_bucket(kv_need)
         fn = self._verify_program(kv_limit, k)
+        self.metrics.note_decode_dispatch(
+            kv_limit, kv_need,
+            *(self._flops_by_key.get(fn.key) or (0.0, 0.0)),
+        )
         tr = self.tracer
         t_d = tr.now() if tr.enabled else 0.0
         if self._check_logits:
@@ -2105,6 +2220,13 @@ class PagedServingEngine:
         self.metrics.host_schedule_ms += max(total_ms - self._wait_ms, 0.0)
         self.metrics.hist_step_ms.observe(total_ms)
         self.metrics.hist_queue_depth.observe(len(self._queue))
+        if self._slo is not None:
+            # SLO burn evaluation BEFORE the ladder update so a raised
+            # alert's _note_event lands in the same step's event window
+            self._slo.on_step(
+                self._step_index, tracer=self.tracer,
+                note_event=self._note_event,
+            )
         self._update_ladder()
         if (
             self.paged.audit_interval
@@ -2117,6 +2239,15 @@ class PagedServingEngine:
             self._last_log_step = steps
             self.metrics.log(logger, self.allocator, self.index)
         self._check_stall()
+        if self.tracer.enabled:
+            m = self.metrics
+            self.tracer.counter(
+                "graftmeter",
+                decode_pad_tokens=m.decode_pad_tokens,
+                prefill_pad_tokens=m.prefill_pad_tokens,
+                dispatched_flops=m.dispatched_flops,
+                mfu_est=round(m.mfu_estimate(), 6),
+            )
         self.tracer.end_step(
             queue=len(self._queue), active=len(self._active),
             wait_ms=round(self._wait_ms, 3),

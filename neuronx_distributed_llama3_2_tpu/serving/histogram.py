@@ -82,6 +82,29 @@ class Histogram:
             cum += n
         return self.max
 
+    def count_over(self, threshold: float) -> float:
+        """Estimated number of observations above ``threshold`` (linear
+        interpolation inside the straddled bucket, the dual of
+        :meth:`percentile`) — the SLO burn-rate monitor (serving/slo.py)
+        differences this cumulative figure between evaluations. Overflow
+        observations interpolate over ``(last_edge, max]``."""
+        if not self.count:
+            return 0.0
+        t = max(float(threshold), 0.0)
+        i = bisect_left(self.bounds, t)
+        if i >= len(self.bounds):           # threshold in overflow range
+            n = self.counts[-1]
+            if not n or t >= self.max:
+                return 0.0
+            lo_edge = self.bounds[-1]
+            span = max(self.max - lo_edge, 1e-12)
+            return n * (self.max - t) / span
+        over = float(sum(self.counts[i + 1:]))
+        lo_edge = self.bounds[i - 1] if i else 0.0
+        hi_edge = self.bounds[i]
+        frac_above = (hi_edge - t) / max(hi_edge - lo_edge, 1e-12)
+        return over + self.counts[i] * frac_above
+
     def snapshot(self) -> dict:
         """JSON-ready summary — the shape embedded in
         ``ServingMetrics.snapshot()`` (golden-keyed in tests)."""

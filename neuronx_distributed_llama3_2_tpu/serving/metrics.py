@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Optional
+from typing import Dict, Optional
 
 from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     BlockAllocator,
@@ -31,6 +31,11 @@ from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
 _GAUGE_FIELDS = frozenset({
     "tp_size", "pool_bytes_per_rank", "pool_bytes_total",
     "degradation_level",
+    # graftmeter static figures (set once at harvest/construction) and
+    # the SLO burn gauges (rewritten each evaluation)
+    "cost_profiled_programs", "hbm_budget_bytes", "hbm_footprint_bytes",
+    "hbm_headroom_bytes", "peak_flops_per_chip", "peak_hbm_bw_per_chip",
+    "slo_burn_ttft", "slo_burn_tpot",
 })
 
 # snapshot key -> hist_* field name (the stable public names dashboards
@@ -96,6 +101,34 @@ class ServingMetrics:
     programs_compiled: int = 0     # ProgramRecord registrations (lifetime)
     prewarm_compiles: int = 0      # of those, made by prewarm()
     steadystate_compiles: int = 0  # of those, made after the freeze
+    # -- graftmeter device-cost accounting (docs/serving.md "Cost
+    #    accounting & SLOs"): pad counters bump unconditionally at every
+    #    dispatch (host ints, the histogram precedent); the FLOP/byte
+    #    counters add the dispatched program's static CostProfile figures
+    #    once engine.ensure_cost_profiles()/prewarm harvested them --
+    decode_pad_tokens: int = 0     # kv rows dispatched past kv_need
+    decode_need_tokens: int = 0    # kv rows the decode batch required
+    prefill_pad_tokens: int = 0    # prefill bucket slots past the suffix
+    prefill_need_tokens: int = 0   # suffix tokens actually prefilled
+    dispatched_flops: float = 0.0  # Σ CostProfile.flops over dispatches
+    dispatched_bytes: float = 0.0  # Σ CostProfile.bytes_accessed
+    decode_pad_by_rung: Dict[int, dict] = dataclasses.field(
+        default_factory=dict)  # kv rung -> {dispatches, need, pad}
+    prefill_pad_by_rung: Dict[int, dict] = dataclasses.field(
+        default_factory=dict)  # prefill bucket -> same shape
+    # static figures (gauges) set by the harvest / at construction:
+    cost_profiled_programs: int = 0  # registry keys carrying a CostProfile
+    hbm_budget_bytes: int = 0        # per-device HBM budget
+    hbm_footprint_bytes: int = 0     # HBMLedger footprint per rank
+    hbm_headroom_bytes: int = 0      # budget - footprint (may go negative)
+    peak_flops_per_chip: float = 0.0   # MFU denominator per chip
+    peak_hbm_bw_per_chip: float = 0.0  # bandwidth-util denominator
+    mfu_by_rung: Dict[int, dict] = dataclasses.field(
+        default_factory=dict)  # kv rung -> static roofline figures
+    # -- SLO burn-rate monitor (serving/slo.py) --
+    slo_alerts: int = 0            # evaluations that raised a burn alert
+    slo_burn_ttft: float = 0.0     # latest windowed TTFT burn rate (gauge)
+    slo_burn_tpot: float = 0.0     # latest windowed TPOT burn rate (gauge)
     # -- fault tolerance (docs/serving.md "Failure handling & degradation") --
     faults_injected: int = 0       # chaos events fired by the FaultInjector
     failed_requests: int = 0       # requests ended in terminal `failed`
@@ -119,6 +152,81 @@ class ServingMetrics:
         default_factory=lambda: Histogram(1.0, 64.0, 2.0))
     hist_queue_depth: Histogram = dataclasses.field(
         default_factory=lambda: Histogram(1.0, 8192.0, 2.0))
+
+    # -- graftmeter per-dispatch accounting (called from the engine's
+    #    dispatch funnels; a few int adds + one dict hit, unconditional
+    #    like the histogram observes) --
+
+    @staticmethod
+    def _note_rung(by_rung: dict, rung: int, need: int, pad: int) -> None:
+        r = by_rung.get(rung)
+        if r is None:
+            r = by_rung[rung] = {
+                "dispatches": 0, "need_tokens": 0, "pad_tokens": 0,
+            }
+        r["dispatches"] += 1
+        r["need_tokens"] += need
+        r["pad_tokens"] += pad
+
+    def note_decode_dispatch(
+        self, rung: int, need: int,
+        flops: float = 0.0, bytes_accessed: float = 0.0,
+    ) -> None:
+        """One decode/verify dispatch at kv rung ``rung`` that actually
+        required ``need`` kv rows; ``flops``/``bytes_accessed`` are the
+        program's static CostProfile figures (0 before harvest)."""
+        pad = max(rung - need, 0)
+        self.decode_need_tokens += need
+        self.decode_pad_tokens += pad
+        self._note_rung(self.decode_pad_by_rung, rung, need, pad)
+        self.dispatched_flops += flops
+        self.dispatched_bytes += bytes_accessed
+
+    def note_prefill_dispatch(
+        self, bucket: int, tokens: int,
+        flops: float = 0.0, bytes_accessed: float = 0.0,
+    ) -> None:
+        """One prefill (whole or chunk) dispatch padded into ``bucket``
+        for ``tokens`` real suffix tokens."""
+        pad = max(bucket - tokens, 0)
+        self.prefill_need_tokens += tokens
+        self.prefill_pad_tokens += pad
+        self._note_rung(self.prefill_pad_by_rung, bucket, tokens, pad)
+        self.dispatched_flops += flops
+        self.dispatched_bytes += bytes_accessed
+
+    @staticmethod
+    def _pad_frac(pad: int, need: int) -> float:
+        total = pad + need
+        return round(pad / total, 4) if total else 0.0
+
+    def pad_waste_frac(self) -> float:
+        """Fraction of all dispatched token slots (decode kv rows +
+        prefill bucket slots) that were bucket padding — the linear
+        proxy for padded-vs-useful FLOPs (the attention extent scales
+        linearly in the padded rows)."""
+        return self._pad_frac(
+            self.decode_pad_tokens + self.prefill_pad_tokens,
+            self.decode_need_tokens + self.prefill_need_tokens,
+        )
+
+    def mfu_estimate(self) -> float:
+        """Achieved FLOP/s over the step-loop wall clock, normalized by
+        the declared peak across the tp group. Zero until CostProfiles
+        were harvested (dispatched_flops stays 0)."""
+        wall_s = (self.host_schedule_ms + self.device_wait_ms) / 1e3
+        peak = self.peak_flops_per_chip * max(self.tp_size, 1)
+        if wall_s <= 0.0 or peak <= 0.0:
+            return 0.0
+        return self.dispatched_flops / wall_s / peak
+
+    def bandwidth_util_estimate(self) -> float:
+        """Achieved bytes/s over wall clock vs the declared HBM peak."""
+        wall_s = (self.host_schedule_ms + self.device_wait_ms) / 1e3
+        peak = self.peak_hbm_bw_per_chip * max(self.tp_size, 1)
+        if wall_s <= 0.0 or peak <= 0.0:
+            return 0.0
+        return self.dispatched_bytes / wall_s / peak
 
     def prefix_skip_fraction(self) -> float:
         """Fraction of admitted prompt tokens that skipped prefill."""
@@ -145,6 +253,32 @@ class ServingMetrics:
         }
         rec["prefix_skip_fraction"] = round(self.prefix_skip_fraction(), 4)
         rec["accept_rate"] = round(self.accept_rate(), 4)
+        # graftmeter derived figures; the per-rung dicts export as copies
+        # enriched with a pad_frac so dashboards never mutate live state
+        rec["decode_pad_by_rung"] = {
+            rung: dict(v, pad_frac=self._pad_frac(
+                v["pad_tokens"], v["need_tokens"]))
+            for rung, v in sorted(self.decode_pad_by_rung.items())
+        }
+        rec["prefill_pad_by_rung"] = {
+            rung: dict(v, pad_frac=self._pad_frac(
+                v["pad_tokens"], v["need_tokens"]))
+            for rung, v in sorted(self.prefill_pad_by_rung.items())
+        }
+        rec["mfu_by_rung"] = {
+            rung: dict(v) for rung, v in sorted(self.mfu_by_rung.items())
+        }
+        rec["pad_waste_frac"] = self.pad_waste_frac()
+        rec["decode_pad_frac"] = self._pad_frac(
+            self.decode_pad_tokens, self.decode_need_tokens)
+        rec["prefill_pad_frac"] = self._pad_frac(
+            self.prefill_pad_tokens, self.prefill_need_tokens)
+        wall_s = (self.host_schedule_ms + self.device_wait_ms) / 1e3
+        rec["achieved_flops_per_s"] = (
+            round(self.dispatched_flops / wall_s, 1) if wall_s > 0 else 0.0
+        )
+        rec["mfu_est"] = round(self.mfu_estimate(), 6)
+        rec["bandwidth_util_est"] = round(self.bandwidth_util_estimate(), 6)
         rec["host_schedule_ms"] = round(self.host_schedule_ms, 3)
         rec["device_wait_ms"] = round(self.device_wait_ms, 3)
         steps = max(self.decode_steps, 1)
@@ -186,6 +320,34 @@ class ServingMetrics:
             kind = "counter" if key in counter_fields else "gauge"
             lines.append(f"# TYPE serving_{key} {kind}")
             lines.append(f"serving_{key} {val:g}")
+        # graftmeter per-rung series: the nested dicts are not flat
+        # numerics, so they render as labelled families instead
+        for snap_key, base in (
+            ("decode_pad_by_rung", "serving_decode"),
+            ("prefill_pad_by_rung", "serving_prefill"),
+        ):
+            rungs = snap.get(snap_key) or {}
+            if rungs:
+                lines.append(f"# TYPE {base}_pad_tokens_rung counter")
+            for rung in sorted(rungs):
+                v = rungs[rung]
+                lines.append(
+                    f'{base}_pad_tokens_rung{{rung="{rung}"}} '
+                    f'{v["pad_tokens"]:g}')
+                lines.append(
+                    f'{base}_dispatches_rung{{rung="{rung}"}} '
+                    f'{v["dispatches"]:g}')
+                lines.append(
+                    f'{base}_pad_frac_rung{{rung="{rung}"}} '
+                    f'{v["pad_frac"]:g}')
+        roofs = snap.get("mfu_by_rung") or {}
+        if roofs:
+            lines.append("# TYPE serving_roofline_mfu_rung gauge")
+        for rung in sorted(roofs):
+            v = roofs[rung]
+            lines.append(
+                f'serving_roofline_mfu_rung{{rung="{rung}"}} '
+                f'{v.get("roofline_mfu", 0.0):g}')
         for key, field_name in _HIST_KEYS.items():
             lines.extend(
                 getattr(self, field_name).prometheus_lines(f"serving_{key}"))

@@ -1,0 +1,175 @@
+"""SLO burn-rate monitoring for the serving engine (graftmeter layer 3).
+
+The operator declares latency objectives — TTFT and/or TPOT p99 targets
+— on :class:`~.engine.PagedConfig`; the monitor computes a **burn rate**
+over the graftscope histograms the engine already observes into
+(``hist_ttft_ms`` / ``hist_tpot_ms``), entirely from host-side counter
+deltas:
+
+    burn = (fraction of recent observations over target) / error budget
+
+where the error budget of a p99 objective is 1%. Burn 1.0 means the
+stream is exactly consuming its budget (1% of observations over target);
+burn 100 means *every* observation missed. The fraction is computed over
+a rolling window of the last ``window_evals`` evaluations (one every
+``eval_steps`` engine steps), weighted by observation count — the
+standard multi-window burn-rate alerting shape, sized in evaluations
+rather than wall time because the engine's clock is its step loop.
+
+When the windowed burn of any objective sits at or above
+``burn_threshold`` with a full window, the monitor raises a structured
+alert: ``metrics.slo_alerts`` increments, the tracer records an
+``slo_burn`` instant (visible in the Chrome trace), and — with
+``PagedConfig.slo_degrade`` — the event feeds the PR 8 degradation
+ladder through the same ``_note_event`` funnel chaos faults use, so
+sustained burn sheds a feature rung and budget refill (clean steps)
+recovers it. Everything is host ints/floats; no device work, ever.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Callable, List, Optional
+
+from neuronx_distributed_llama3_2_tpu.serving.histogram import Histogram
+from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOPolicy:
+    """Declared latency objectives + burn-window shape (immutable; built
+    from the PagedConfig knobs by :meth:`from_paged`)."""
+
+    ttft_p99_ms: Optional[float] = None
+    tpot_p99_ms: Optional[float] = None
+    quantile: float = 0.99        # the objective quantile (budget = 1 - q)
+    eval_steps: int = 16          # engine steps between burn evaluations
+    window_evals: int = 4         # evaluations per rolling burn window
+    burn_threshold: float = 1.0   # windowed burn rate that raises an alert
+    degrade: bool = False         # alerts feed the degradation ladder
+
+    @classmethod
+    def from_paged(cls, paged: Any) -> "SLOPolicy":
+        return cls(
+            ttft_p99_ms=paged.slo_ttft_p99_ms,
+            tpot_p99_ms=paged.slo_tpot_p99_ms,
+            eval_steps=max(int(paged.slo_eval_steps), 1),
+            window_evals=max(int(paged.slo_burn_window), 1),
+            burn_threshold=float(paged.slo_burn_threshold),
+            degrade=bool(paged.slo_degrade),
+        )
+
+    @property
+    def active(self) -> bool:
+        return self.ttft_p99_ms is not None or self.tpot_p99_ms is not None
+
+    @property
+    def budget(self) -> float:
+        """Error budget: the fraction of observations allowed over
+        target (0.01 for a p99 objective)."""
+        return max(1.0 - self.quantile, 1e-9)
+
+
+class _Objective:
+    """Rolling burn state for one (name, target, histogram) triple."""
+
+    __slots__ = ("name", "target_ms", "hist", "_last_count", "_last_over",
+                 "window", "burn")
+
+    def __init__(self, name: str, target_ms: float, hist: Histogram,
+                 window_evals: int):
+        self.name = name
+        self.target_ms = float(target_ms)
+        self.hist = hist
+        self._last_count = hist.count
+        self._last_over = hist.count_over(self.target_ms)
+        # (over_delta, count_delta) per evaluation
+        self.window: deque = deque(maxlen=window_evals)
+        self.burn = 0.0
+
+    def evaluate(self, budget: float) -> float:
+        count = self.hist.count
+        over = self.hist.count_over(self.target_ms)
+        d_count = max(count - self._last_count, 0)
+        d_over = max(over - self._last_over, 0.0)
+        self._last_count, self._last_over = count, over
+        self.window.append((d_over, d_count))
+        n = sum(c for _, c in self.window)
+        frac = sum(o for o, _ in self.window) / n if n else 0.0
+        self.burn = frac / budget
+        return self.burn
+
+    @property
+    def window_full(self) -> bool:
+        return len(self.window) == self.window.maxlen
+
+    @property
+    def window_observations(self) -> int:
+        return sum(c for _, c in self.window)
+
+
+class SLOMonitor:
+    """Evaluates the declared objectives every ``eval_steps`` engine
+    steps; owned by the engine and driven from ``step()`` (tracer
+    instants only record while a step is open). Inert — a single modulo
+    test per step — when no objective is declared."""
+
+    def __init__(self, policy: SLOPolicy, metrics: ServingMetrics):
+        self.policy = policy
+        self.metrics = metrics
+        self.objectives: List[_Objective] = []
+        if policy.ttft_p99_ms is not None:
+            self.objectives.append(_Objective(
+                "ttft", policy.ttft_p99_ms, metrics.hist_ttft_ms,
+                policy.window_evals,
+            ))
+        if policy.tpot_p99_ms is not None:
+            self.objectives.append(_Objective(
+                "tpot", policy.tpot_p99_ms, metrics.hist_tpot_ms,
+                policy.window_evals,
+            ))
+
+    def on_step(
+        self,
+        step_index: int,
+        tracer: Any = None,
+        note_event: Optional[Callable[[], None]] = None,
+    ) -> bool:
+        """Evaluate burn at the policy cadence. Returns True iff this
+        call raised an alert (at most one alert per evaluation, however
+        many objectives are burning)."""
+        if not self.objectives:
+            return False
+        if step_index % self.policy.eval_steps:
+            return False
+        burning = []
+        budget = self.policy.budget
+        for obj in self.objectives:
+            burn = obj.evaluate(budget)
+            if obj.name == "ttft":
+                self.metrics.slo_burn_ttft = round(burn, 4)
+            else:
+                self.metrics.slo_burn_tpot = round(burn, 4)
+            # "sustained": a full window with real observations — a cold
+            # or idle window can never alert
+            if (
+                obj.window_full
+                and obj.window_observations > 0
+                and burn >= self.policy.burn_threshold
+            ):
+                burning.append(obj)
+        if not burning:
+            return False
+        self.metrics.slo_alerts += 1
+        if tracer is not None:
+            tracer.instant(
+                "slo_burn",
+                objectives=[o.name for o in burning],
+                ttft_burn=self.metrics.slo_burn_ttft,
+                tpot_burn=self.metrics.slo_burn_tpot,
+                threshold=self.policy.burn_threshold,
+            )
+        if self.policy.degrade and note_event is not None:
+            note_event()
+        return True

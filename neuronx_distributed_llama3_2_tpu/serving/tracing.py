@@ -150,6 +150,15 @@ class EngineTracer:
             return
         self._cur.append(("i", name, time.perf_counter(), None, args))
 
+    def counter(self, name: str, **values: Any) -> None:
+        """Chrome counter sample (ph "C"): a named set of numeric series
+        the trace viewer plots as stacked graphs over the step timeline —
+        graftmeter emits its cumulative pad/FLOP counters here once per
+        traced step. Same drop rule as :meth:`instant`."""
+        if not self.enabled or self._cur is None:
+            return
+        self._cur.append(("C", name, time.perf_counter(), None, values))
+
     def request_state(self, rid: int, state: str) -> None:
         if not self.enabled:
             return
@@ -188,6 +197,8 @@ class EngineTracer:
                       "tid": 0, "ts": self._us(t0), "args": args}
                 if ph == "X":
                     ev["dur"] = self._us(t1 - t0)
+                elif ph == "C":
+                    ev["cat"] = "counter"
                 else:
                     ev["cat"] = "event"
                     ev["s"] = "p"       # process-scoped instant

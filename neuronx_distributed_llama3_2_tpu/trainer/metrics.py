@@ -12,6 +12,14 @@ import time
 from collections import deque
 from typing import Optional
 
+# the FLOP formula moved to the shared module so the serving engine's
+# CostProfiles (serving/accounting.py) and the training bench compute MFU
+# from ONE expression; re-exported here for existing importers
+from neuronx_distributed_llama3_2_tpu.flops import (  # noqa: F401
+    mfu,
+    train_flops_per_token,
+)
+
 
 class Throughput:
     """seqs/s = window · (batch·dp·grad_accum) / window_time, moving window
@@ -58,25 +66,3 @@ class TrainingMetrics:
             f.write(json.dumps(rec) + "\n")
 
 
-def train_flops_per_token(
-    num_params: int, num_layers: int, hidden_size: int, seq_len: int
-) -> float:
-    """Per-token training FLOPs: the standard 6N plus attention correction
-    (≈ 6·N + 12·L·H·S). Single source of truth for MFU and bench targets."""
-    return 6 * num_params + 12 * num_layers * hidden_size * seq_len
-
-
-def mfu(
-    tokens_per_sec: float,
-    num_params: int,
-    num_layers: int,
-    hidden_size: int,
-    seq_len: int,
-    peak_flops_per_chip: float,
-    num_chips: int = 1,
-) -> float:
-    """Model FLOPs utilization."""
-    achieved = tokens_per_sec * train_flops_per_token(
-        num_params, num_layers, hidden_size, seq_len
-    )
-    return achieved / (peak_flops_per_chip * num_chips)

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """graftcheck CI gate: trace the serving engine's representative programs
-and enforce the GC001-GC008 program-level rules.
+and enforce the GC001-GC009 program-level rules.
 
 Usage:
     python scripts/graftcheck_gate.py                   # run the catalog
@@ -9,6 +9,8 @@ Usage:
     python scripts/graftcheck_gate.py --write-baseline
     python scripts/graftcheck_gate.py --catalog-diff    # manifest vs registry
     python scripts/graftcheck_gate.py --write-catalog   # refresh the golden
+    python scripts/graftcheck_gate.py --costs-diff      # cost table vs golden
+    python scripts/graftcheck_gate.py --write-costs     # refresh cost golden
 
 Where shardlint_gate.py lints source ASTs, this gate lints *programs*: it
 builds tiny CPU-hosted serving engines, runs a few requests so the real
@@ -27,6 +29,15 @@ spec verify, int8, tp=2) and the resulting program registry must be
 match the checked-in golden ``scripts/graftcheck_catalog.txt``. Ladder
 changes are therefore reviewed diffs: run ``--write-catalog`` and commit
 the golden alongside the PagedConfig change.
+
+The ``costs-*`` flags do the same for graftmeter's device-cost ledger
+(GC009; serving/accounting.py): the *analytic* CostProfile table over the
+catalog's prewarm keys — backend-independent arithmetic, so the golden
+``scripts/graftcheck_costs.txt`` is stable across XLA versions — must
+match the checked-in golden. A cost drift means the model dimensions,
+ladder, or cost formulas changed; refresh with ``--write-costs`` and a
+rationale. The prewarmed catalog entries additionally assert (GC009)
+that every registered program carries a usable harvested CostProfile.
 
 The tier-1 suite runs this gate as
 ``tests/test_graftcheck.py::test_self_audit`` — no separate CI plumbing.
@@ -99,6 +110,9 @@ DEFAULT_BASELINE = os.path.join(
 )
 DEFAULT_CATALOG = os.path.join(
     REPO_ROOT, "scripts", "graftcheck_catalog.txt"
+)
+DEFAULT_COSTS = os.path.join(
+    REPO_ROOT, "scripts", "graftcheck_costs.txt"
 )
 
 _TINY = None
@@ -288,10 +302,59 @@ def _catalog_drift(name, engine, catalog_path=DEFAULT_CATALOG):
     return findings
 
 
+def _cost_lines(engine):
+    """Deterministic analytic cost-table lines for the engine's catalog
+    prewarm keys (no compiles, no XLA figures — see --write-costs)."""
+    from neuronx_distributed_llama3_2_tpu.serving.accounting import (
+        analytic_profiles,
+        cost_table_lines,
+    )
+
+    return cost_table_lines(analytic_profiles(engine))
+
+
+def _costs_drift(name, engine, costs_path=DEFAULT_COSTS):
+    """The GC009 golden arm: the analytic cost table must match the
+    checked-in ``graftcheck_costs.txt`` entry line for line."""
+    findings = []
+    label = f"gate:{name}"
+    golden = read_catalog_file(costs_path)
+    want = _cost_lines(engine)
+    if name not in golden:
+        findings.append(Finding(
+            rule="GC009", program=label,
+            message=f"no golden cost-table entry '{name}' in {costs_path}",
+            hint="run scripts/graftcheck_gate.py --write-costs and commit "
+                 "the refreshed golden",
+            detail=f"golden-missing:{name}",
+        ))
+        return findings
+    for line in sorted(set(want) - set(golden[name])):
+        findings.append(Finding(
+            rule="GC009", program=label,
+            message=f"cost-table line {line!r} is not in the golden "
+                    "(model dims, ladder, or cost formulas drifted)",
+            hint="if the change is intentional, run --write-costs and "
+                 "commit the golden with a rationale",
+            detail=f"costs-add:{line}",
+        ))
+    for line in sorted(set(golden[name]) - set(want)):
+        findings.append(Finding(
+            rule="GC009", program=label,
+            message=f"golden cost-table line {line!r} is no longer "
+                    "produced (model dims, ladder, or formulas drifted)",
+            hint="if the change is intentional, run --write-costs and "
+                 "commit the golden with a rationale",
+            detail=f"costs-drop:{line}",
+        ))
+    return findings
+
+
 def entry_catalog():
     """Prewarmed int8+spec+chunked+async engine under heterogeneous
-    traffic: full registry audit (GC001-GC008) plus the byte-identity
-    check registry == manifest == golden. Runs while no mesh is live."""
+    traffic: full registry audit (GC001-GC009) plus the byte-identity
+    checks registry == manifest == golden and analytic cost table ==
+    golden. Runs while no mesh is live."""
     engine = _catalog_engine()
     # lengths straddle chunk=6 (whole-prefill and chunk-walk), cross the
     # 8/16 prefill buckets, and push positions across the kv rungs
@@ -300,7 +363,11 @@ def entry_catalog():
         "catalog engine compiled past the freeze: "
         f"{engine.metrics.steadystate_compiles}"
     )
-    return audit_programs(engine) + _catalog_drift("catalog-int8", engine)
+    return (
+        audit_programs(engine)
+        + _catalog_drift("catalog-int8", engine)
+        + _costs_drift("catalog-int8", engine)
+    )
 
 
 def entry_catalog_tp2():
@@ -324,6 +391,7 @@ def entry_catalog_tp2():
         return (
             audit_programs(engine)
             + _catalog_drift("catalog-tp2", engine)
+            + _costs_drift("catalog-tp2", engine)
         )
     finally:
         destroy_model_parallel()
@@ -434,6 +502,17 @@ def main(argv=None) -> int:
         help="print manifest-vs-registry-vs-golden drift for the "
              "catalog-* entries and exit nonzero on any mismatch",
     )
+    ap.add_argument("--costs-file", default=DEFAULT_COSTS)
+    ap.add_argument(
+        "--write-costs", action="store_true",
+        help="rewrite the golden analytic cost table (no compiles — "
+             "analytic profiles are construction-time arithmetic)",
+    )
+    ap.add_argument(
+        "--costs-diff", action="store_true",
+        help="print analytic-cost-table-vs-golden drift for the "
+             "catalog-* entries and exit nonzero on any mismatch",
+    )
     args = ap.parse_args(argv)
 
     if args.write_catalog:
@@ -458,6 +537,74 @@ def main(argv=None) -> int:
         n = sum(len(m.lines()) for m in entries.values())
         print(f"wrote {n} manifest key(s) to {args.catalog_file}")
         return 0
+
+    if args.write_costs:
+        # prewarm=False twins of --write-catalog: the analytic table
+        # needs only the manifest keys and the engine dimensions
+        from neuronx_distributed_llama3_2_tpu.parallel.state import (
+            destroy_model_parallel,
+            initialize_model_parallel,
+        )
+
+        entries = {"catalog-int8": _cost_lines(_catalog_engine(prewarm=False))}
+        initialize_model_parallel(
+            tensor_model_parallel_size=2, devices=jax.devices()[:2]
+        )
+        try:
+            entries["catalog-tp2"] = _cost_lines(
+                _catalog_tp2_engine(prewarm=False)
+            )
+        finally:
+            destroy_model_parallel()
+        with open(args.costs_file, "w") as fh:
+            fh.write(
+                "# graftmeter golden analytic cost table: per-program "
+                "FLOPs/bytes the device-cost\n# ledger computes for each "
+                "gate entry's catalog (GC009 contract; "
+                "serving/accounting.py).\n# Analytic figures only — "
+                "backend-independent, so drift means model dims, the\n"
+                "# ladder, or the cost formulas changed. Regenerate "
+                "with:\n#     python scripts/graftcheck_gate.py "
+                "--write-costs\n# Format: <entry> <program key> "
+                "flops=.. bytes=.. arg=.. src=..\n"
+            )
+            for name in sorted(entries):
+                for line in entries[name]:
+                    fh.write(f"{name} {line}\n")
+        n = sum(len(v) for v in entries.values())
+        print(f"wrote {n} cost line(s) to {args.costs_file}")
+        return 0
+
+    if args.costs_diff:
+        rc = 0
+        from neuronx_distributed_llama3_2_tpu.parallel.state import (
+            destroy_model_parallel,
+            initialize_model_parallel,
+        )
+
+        drift = _costs_drift(
+            "catalog-int8", _catalog_engine(prewarm=False), args.costs_file
+        )
+        initialize_model_parallel(
+            tensor_model_parallel_size=2, devices=jax.devices()[:2]
+        )
+        try:
+            drift += _costs_drift(
+                "catalog-tp2", _catalog_tp2_engine(prewarm=False),
+                args.costs_file,
+            )
+        finally:
+            destroy_model_parallel()
+        if not drift:
+            print("costs: analytic table == golden")
+            return 0
+        for f in drift:
+            sign = "-" if f.detail.startswith(
+                ("costs-drop:", "golden-missing:")
+            ) else "+"
+            print(f"{f.program.split(':', 1)[1]}: {sign} "
+                  f"{f.detail.split(':', 1)[1]}  [{f.rule}]")
+        return 1
 
     if args.catalog_diff:
         rc = 0

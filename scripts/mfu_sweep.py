@@ -95,7 +95,13 @@ def main() -> None:
                   f"{d['step_ms']:>10.1f}")
     best = results[0]
     if best[1] is not None:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from neuronx_distributed_llama3_2_tpu.flops import PEAK_FLOPS_PER_CHIP
+
         print("\nbest:", best[0])
+        print(f"# peak {PEAK_FLOPS_PER_CHIP / 1e12:.0f} TFLOP/s/chip "
+              f"(flops.py); BASELINE.md north star is 45% MFU")
         print(json.dumps(best[2]))
 
 

@@ -294,11 +294,17 @@ def _async_ab(config, params, args):
                 trace_enabled=async_loop,
             ),
         )
+        # graftmeter: the lazily-warmed bench engine harvests explicitly —
+        # before the run so the per-dispatch FLOP fold sees the warmup
+        # programs' profiles, and again after so the ledger/profile count
+        # covers programs first compiled under traffic
+        paged.ensure_cost_profiles()
         for p in prompts:
             paged.submit(p)
         t0 = time.perf_counter()
         out = paged.run_to_completion()
         wall = time.perf_counter() - t0
+        paged.ensure_cost_profiles()
         snap = paged.metrics.snapshot()
         return out, paged.metrics.decode_steps / wall, snap, paged
 
@@ -311,6 +317,9 @@ def _async_ab(config, params, args):
         "async_parity": out_sync == out_async,
         "async_steps": snap_async["decode_steps_async"],
         "lame_duck_tokens": snap_async["lame_duck_tokens"],
+        "mfu_est": snap_async["mfu_est"],
+        "pad_waste_frac": snap_async["pad_waste_frac"],
+        "hbm_headroom_bytes": snap_async["hbm_headroom_bytes"],
         "sync_host_schedule_ms_per_step": snap_sync["host_schedule_ms_per_step"],
         "sync_device_wait_ms_per_step": snap_sync["device_wait_ms_per_step"],
         "async_host_schedule_ms_per_step": snap_async["host_schedule_ms_per_step"],

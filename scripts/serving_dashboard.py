@@ -91,6 +91,46 @@ def render_snapshot(snap: dict) -> str:
     accept = g("accept_len")
     if accept and accept.get("count"):
         lines.insert(9, _hist_row("accept", accept))
+    # graftmeter panels (docs/serving.md "Cost accounting & SLOs"): only
+    # rendered when the snapshot carries the cost-accounting keys, so the
+    # dashboard still draws pre-graftmeter records
+    if g("cost_profiled_programs"):
+        budget = float(g("hbm_budget_bytes", 0) or 0)
+        foot = float(g("hbm_footprint_bytes", 0) or 0)
+        used = foot / budget if budget else 0.0
+        gib = 2**30
+        lines.append(
+            f"capacity   hbm {foot / gib:.2f}/{budget / gib:.2f} GiB "
+            f"[{_bar(used)}]  headroom "
+            f"{float(g('hbm_headroom_bytes', 0) or 0) / gib:.2f} GiB  "
+            f"profiles {g('cost_profiled_programs', 0)}"
+        )
+    if "mfu_est" in snap:
+        lines.append(
+            f"mfu        est {g('mfu_est', 0.0)} "
+            f"[{_bar(float(g('mfu_est', 0.0) or 0.0))}]  "
+            f"achieved {float(g('achieved_flops_per_s', 0.0) or 0.0):.3g} "
+            f"FLOP/s  bw_util {g('bandwidth_util_est', 0.0)}  "
+            f"pad_waste {g('pad_waste_frac', 0.0)}"
+        )
+        for key, tag in (("decode_pad_by_rung", "decode"),
+                         ("prefill_pad_by_rung", "prefill")):
+            rungs = g(key) or {}
+            if rungs:
+                row = "  ".join(
+                    f"{r}:{v['pad_frac']:.2f}"
+                    for r, v in sorted(
+                        rungs.items(), key=lambda kv: int(kv[0])
+                    )
+                )
+                lines.append(f"  pad/rung {tag:<8} {row}")
+    if "slo_alerts" in snap and (
+        g("slo_burn_ttft") or g("slo_burn_tpot") or g("slo_alerts")
+    ):
+        lines.append(
+            f"slo        burn ttft {g('slo_burn_ttft', 0.0)}  "
+            f"tpot {g('slo_burn_tpot', 0.0)}  alerts {g('slo_alerts', 0)}"
+        )
     return "\n".join(lines)
 
 
@@ -138,8 +178,15 @@ def _demo() -> int:
         PagedConfig(
             block_size=8, num_blocks=32, async_loop=True,
             trace_enabled=True,
+            # graftmeter demo coverage: SLO burn gauges render on the
+            # dashboard (loose targets, so the demo stays alert-free)
+            slo_ttft_p99_ms=60_000.0, slo_tpot_p99_ms=60_000.0,
+            slo_eval_steps=4,
         ),
     )
+    # the demo engine warms lazily (no prewarm), so harvest explicitly to
+    # light up the capacity/MFU panels
+    paged.ensure_cost_profiles()
     rng = __import__("numpy").random.default_rng(0)
     for n in (5, 11, 7, 19):
         paged.submit(rng.integers(1, cfg.vocab_size, size=n).tolist())

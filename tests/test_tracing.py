@@ -228,9 +228,19 @@ EXPECTED_SNAPSHOT_KEYS = {
     "drafter_faults", "degradation_level", "degradations",
     "audit_violations", "programs_compiled", "prewarm_compiles",
     "steadystate_compiles",
+    # graftmeter: pad-waste / dispatch-cost counters + cost-ledger gauges
+    "decode_pad_tokens", "decode_need_tokens", "prefill_pad_tokens",
+    "prefill_need_tokens", "dispatched_flops", "dispatched_bytes",
+    "decode_pad_by_rung", "prefill_pad_by_rung", "cost_profiled_programs",
+    "hbm_budget_bytes", "hbm_footprint_bytes", "hbm_headroom_bytes",
+    "peak_flops_per_chip", "peak_hbm_bw_per_chip", "mfu_by_rung",
+    "slo_alerts", "slo_burn_ttft", "slo_burn_tpot",
     # derived
     "prefix_skip_fraction", "accept_rate", "host_schedule_ms_per_step",
     "device_wait_ms_per_step",
+    # graftmeter derived
+    "pad_waste_frac", "decode_pad_frac", "prefill_pad_frac",
+    "achieved_flops_per_s", "mfu_est", "bandwidth_util_est",
     # latency histogram summaries
     "ttft_ms", "tpot_ms", "step_latency_ms", "accept_len", "queue_depth",
     # allocator stats
