@@ -32,6 +32,10 @@ GC004  collective audit: no collective primitive inside a
 GC005  quantized-pool arithmetic: values leaving an int8/fp8 array must
        widen to fp32 (converts target f32, dots carry an fp32
        accumulator) — never bf16/f16 arithmetic on low-bit payloads.
+       Knob-aware: with ``config.quant_mxu`` on, int8 dots may
+       accumulate in int32 (the MXU-native path — scales are applied
+       to the fp32 score matrix afterwards); with the knob off that
+       same dot is still a finding.
 GC006  program-registry purity: a fault-free engine compiles no
        ``checked`` program variants and an undegraded engine no
        gather-fallback variants.
@@ -95,7 +99,10 @@ GC_RULES: Dict[str, str] = {
     "GC002": "declared donation dropped at lowering (no input-output alias)",
     "GC003": "host transfer (device_put/callback) in a steady-state program",
     "GC004": "collective in a collective-free region or on an undeclared axis",
-    "GC005": "low-bit (quantized-pool) value used without fp32 widening",
+    "GC005": (
+        "low-bit (quantized-pool) value used without fp32 widening "
+        "(int8->int32 dots permitted iff config.quant_mxu)"
+    ),
     "GC006": "fault-free engine compiled a checked/gather program variant",
     "GC007": "program key not derivable from the declared catalog manifest",
     "GC008": "registry grew or a key re-lowered after the steady-state freeze",
@@ -429,12 +436,21 @@ def check_fp32_widening(
     jaxpr_or_closed: Any,
     program: str = "<program>",
     suppress: Iterable[str] = (),
+    quant_mxu: bool = False,
 ) -> List[Finding]:
     """GC005: every equation consuming an int8/fp8 (quantized-pool)
     operand must either be structural (move the payload), convert it to
     float32, or be a dot with an fp32 accumulator. Arithmetic directly on
     low-bit payloads — or a widen that targets bf16/f16 — silently
-    changes serving numerics vs the token-identical contract."""
+    changes serving numerics vs the token-identical contract.
+
+    ``quant_mxu`` makes the rule knob-aware: when the engine's model
+    config declares the MXU-native dot (``config.quant_mxu``), an int8
+    dot accumulating in int32 is the INTENDED lowering (the k-scale
+    column and the requantized q row scale are applied to the fp32
+    score matrix after the dot), so that one shape is permitted. With
+    the knob off the same dot is still a finding — fp32 widening is
+    required exactly iff quant_mxu is off."""
     if "GC005" in suppress:
         return []
     out: List[Finding] = []
@@ -461,11 +477,14 @@ def check_fp32_widening(
                 bad = f"convert {low[0]} -> {target} (must widen to float32)"
         elif name == "dot_general":
             acc = _dtype_name(eqn.outvars[0])
+            if quant_mxu and low == ["int8"] and acc == "int32":
+                continue  # MXU-native int8 dot: scales applied post-dot
             if acc != "float32":
                 bad = (
                     f"dot_general on {'/'.join(low)} accumulates in "
                     f"{acc or '<unknown>'} (needs "
-                    "preferred_element_type=float32)"
+                    "preferred_element_type=float32, or int32 under "
+                    "config.quant_mxu)"
                 )
         else:
             bad = f"{name} consumes {'/'.join(low)} without fp32 widening"
@@ -755,7 +774,12 @@ def audit_programs(
         )
         if getattr(engine, "_kv_quantized", False):
             findings.extend(
-                check_fp32_widening(closed, label, suppress=suppress)
+                check_fp32_widening(
+                    closed, label, suppress=suppress,
+                    quant_mxu=getattr(
+                        engine.model.config, "quant_mxu", False
+                    ),
+                )
             )
         if rec.kind in ("pdecode", "pverify") and not rec.gather:
             t = 1 + int(rec.meta.get("k", 0))

@@ -549,11 +549,13 @@ class LlamaDecode:
                         q, kc, vc, block_tables, positions,
                         mesh=parallel_state.get_parallel_state().mesh,
                         kv_limit=limit, k_scale=ksc, v_scale=vsc,
+                        quant_mxu=c.quant_mxu and ksc is not None,
                     )
                 else:
                     att = paged_flash_decode(
                         q, kc, vc, block_tables, positions, kv_limit=limit,
                         k_scale=ksc, v_scale=vsc,
+                        quant_mxu=c.quant_mxu and ksc is not None,
                     )
                 att = constrain(att, P(BATCH_AXES, None, ha, None))
             else:
@@ -592,7 +594,9 @@ class LlamaDecode:
         *,
         kv_limit: Optional[int] = None,
         pos_cap: Optional[int] = None,
-    ) -> Tuple[jax.Array, jax.Array, PagedKVCache]:
+        sampling: Optional[tuple] = None,
+        logit_poison: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, ...]:
         """One resident-state decode step: T=1 paged forward plus the
         on-device state advance. Returns ``(logits (b, V), new_positions,
         cache)`` where ``new_positions = positions + 1`` — the sampled token
@@ -606,15 +610,45 @@ class LlamaDecode:
         a cap a long-idle lane's position would eventually walk past the
         rope table. The cap only ever binds on such garbage lanes: real
         lanes finish at ``max_seq_len - 1``, below any sane cap.
+
+        ``sampling`` opts into fused on-device sampling
+        (``PagedConfig.on_device_sampling``): a ``(rng_data (b, 2) uint32,
+        temperature (b,), top_k (b,), top_p (b,))`` tuple of device-resident
+        per-lane arrays — the first return becomes the sampled int32 tokens
+        instead of logits, drawn by :func:`..sampling.sample_lanes` with the
+        per-lane key folded by the landing index ``positions + 1`` (pre-cap:
+        the clamp only ever binds on garbage lanes). ``logit_poison``
+        composes the checked variant in-fuse: the finite check runs on the
+        raw logits *before* sampling and a ``finite (b,)`` bool slots in
+        after the first return — ``(tokens, finite, new_positions, cache)``.
+        Both default to None (static), leaving the host-sampling traces
+        bitwise unchanged.
         """
         logits, cache = self.forward(
             params, cache, tokens[:, None], positions, None,
             block_tables=block_tables, kv_limit=kv_limit,
         )
+        logits = logits[:, 0, :]
+        finite = None
+        if logit_poison is not None:
+            logits, finite = self.finite_logit_check(logits, logit_poison)
         new_positions = positions + 1
         if pos_cap is not None:
             new_positions = jnp.minimum(new_positions, pos_cap)
-        return logits[:, 0, :], new_positions, cache
+        if sampling is not None:
+            from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+                sample_lanes,
+            )
+
+            rng_data, temperature, top_k, top_p = sampling
+            out = sample_lanes(
+                logits, rng_data, positions + 1, temperature, top_k, top_p
+            )
+        else:
+            out = logits
+        if finite is not None:
+            return out, finite, new_positions, cache
+        return out, new_positions, cache
 
     @staticmethod
     def finite_logit_check(
@@ -651,6 +685,7 @@ class LlamaDecode:
         kv_limit: Optional[int] = None,
         pos_cap: Optional[int] = None,
         logit_poison: Optional[jax.Array] = None,
+        sampling: Optional[tuple] = None,
     ) -> Tuple[jax.Array, ...]:
         """One speculative verify step: the greedy multi-token sibling of
         :meth:`decode_step`. The candidate block ``[cur, d_0 .. d_{k-1}]``
@@ -670,9 +705,17 @@ class LlamaDecode:
         Rejected rows ``> positions + accept`` need no rollback: the
         block-causal mask never looks past the frontier, so the next step
         simply overwrites them (the overwrite-frontier trick of
-        :mod:`.speculative`). Greedy-only: acceptance compares against
+        :mod:`.speculative`). By default acceptance compares against
         ``argmax``, which is exactly ``sample()`` under
         ``SamplingConfig(greedy=True)``.
+
+        ``sampling`` — the same ``(rng_data, temperature, top_k, top_p)``
+        per-lane tuple :meth:`decode_step` takes — lifts the greedy-only
+        restriction: the per-row targets become position-keyed draws
+        (``fold_in(lane_key, positions + 1 + j)`` for row j), so the
+        accepted stream is deterministically equivalent to the sequential
+        fused-sampling decode of the same lane — a lane at the greedy
+        sentinel (``temperature <= 0``) reduces exactly to the argmax rule.
 
         ``logit_poison`` (b,) int32 opts into the checked variant: logits
         run through :meth:`finite_logit_check` *before* the accept rule and
@@ -692,9 +735,24 @@ class LlamaDecode:
         finite = None
         if logit_poison is not None:
             logits, finite = self.finite_logit_check(logits, logit_poison)
-        # greedy[i, j] = target's token for row positions[i] + j + 1
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        accept, emitted = accept_rule(tokens[:, 1:], greedy, draft_len=draft_len)
+        if sampling is not None:
+            from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+                sample_lanes,
+            )
+
+            rng_data, temperature, top_k, top_p = sampling
+            # targets[i, j] = the token this lane WOULD emit at row
+            # positions[i] + j + 1 — keyed by that landing index, so the
+            # accept comparison replays the sequential sampled stream
+            kp1 = tokens.shape[1]
+            index = positions[:, None] + 1 + jnp.arange(kp1, dtype=jnp.int32)
+            targets = sample_lanes(
+                logits, rng_data, index, temperature, top_k, top_p
+            )
+        else:
+            # targets[i, j] = target's argmax for row positions[i] + j + 1
+            targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        accept, emitted = accept_rule(tokens[:, 1:], targets, draft_len=draft_len)
         new_tokens = jnp.take_along_axis(emitted, accept[:, None], axis=1)[:, 0]
         new_positions = positions + accept + 1
         if pos_cap is not None:

@@ -75,7 +75,7 @@ def _decode_kernel(
     #            m_ref / l_ref (t*G, 1) f32 per-split running max / denom,
     #            and the m/l/acc VMEM scratch
     bs: int, bps: int, nblk: int, t: int, g: int, sm_scale: float,
-    quantized: bool = False,
+    quantized: bool = False, quant_mxu: bool = False,
 ):
     if quantized:
         # int8/fp8 pool: the block DMA moved low-bit payload + the block's
@@ -105,16 +105,55 @@ def _decode_kernel(
     @pl.when(run)
     def _compute():
         q = q_ref[:]                               # (t*G, D)
-        if ks_ref is not None:
-            k = (
-                k_ref[:].astype(jnp.float32) * ks_ref[:].astype(jnp.float32)
-            ).astype(q.dtype)                      # (bs, D)
+        if ks_ref is not None and quant_mxu:
+            # low-precision MXU q·k: keep the stored payload as a dot
+            # operand instead of widening it first. Both absmax scales
+            # factor algebraically out of the contraction —
+            # sc[r, c] = q_scale[r] * k_scale[c] * Σ_d q̂[r,d]·k̂[c,d] —
+            # so they apply to the fp32 outputs the LSE combine consumes,
+            # never per-element before the dot.
+            ks_col = ks_ref[:, 0].astype(jnp.float32)          # (bs,)
+            if k_ref.dtype == jnp.int8:
+                # int8 pool: quantize the query tile per row (symmetric
+                # absmax / 127, the kv_quantize formula) so the MXU runs
+                # int8 × int8 accumulating in int32
+                qf = q.astype(jnp.float32)
+                q_scl = jnp.maximum(
+                    jnp.max(jnp.abs(qf), axis=1), 1e-6
+                ) / 127.0                                      # (t*G,)
+                q_i8 = jnp.clip(
+                    jnp.round(qf / q_scl[:, None]), -127.0, 127.0
+                ).astype(jnp.int8)
+                acc = lax.dot_general(
+                    q_i8, k_ref[:], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )                                              # (t*G, bs) i32
+                sc = (
+                    acc.astype(jnp.float32)
+                    * q_scl[:, None] * ks_col[None, :] * sm_scale
+                )
+            else:
+                # fp8 pool: fp8 × fp8 operands with an fp32
+                # preferred_element_type — no query requantization needed,
+                # the cast is the same narrowing kv_quantize applied on
+                # write; only k's stored scale remains to factor out
+                acc = lax.dot_general(
+                    q.astype(k_ref.dtype), k_ref[:],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )                                              # (t*G, bs) f32
+                sc = acc * ks_col[None, :] * sm_scale
         else:
-            k = k_ref[:].astype(q.dtype)           # (bs, D)
-        sc = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * sm_scale                               # (t*G, bs) fp32
+            if ks_ref is not None:
+                k = (
+                    k_ref[:].astype(jnp.float32) * ks_ref[:].astype(jnp.float32)
+                ).astype(q.dtype)                  # (bs, D)
+            else:
+                k = k_ref[:].astype(q.dtype)       # (bs, D)
+            sc = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale                           # (t*G, bs) fp32
         rows = lb * bs + lax.broadcasted_iota(jnp.int32, sc.shape, 1)
         # block-causal across the fresh tokens: tile row r holds query
         # token ti = r // g, which sits at sequence row pos + ti
@@ -167,6 +206,7 @@ def paged_flash_decode(
     interpret: bool | None = None,
     k_scale: jax.Array | None = None,  # (num_blocks, bs, NKV) — quantized pool
     v_scale: jax.Array | None = None,
+    quant_mxu: bool = False,
 ) -> jax.Array:
     """Gather-free paged decode attention; returns q's shape in q.dtype.
 
@@ -187,6 +227,16 @@ def paged_flash_decode(
     the scale columns ride through the *same* table-dereferencing index map
     as the payload blocks — one extra tiny (bs, 1) DMA per block — and the
     kernel dequantizes in VMEM, so HBM traffic stays low-bit.
+
+    ``quant_mxu`` (quantized pool only) keeps the q·k dot itself in low
+    precision: int8 pools contract int8 × int8 operands accumulating in
+    int32 (the query tile is requantized per row in VMEM), fp8 pools run
+    fp8 × fp8 with ``preferred_element_type=float32`` — the absmax scales
+    factor out of the contraction and multiply the fp32 score outputs, so
+    no per-element pre-dot dequant happens. The p·v dot keeps the
+    dequant-widen path (p is a freshly-computed fp probability, not a
+    stored payload). Off (default), both dots see fp32-widened operands —
+    the graftcheck GC005 contract for ``quant_mxu=False`` engines.
     """
     squeeze = q.ndim == 3
     if squeeze:
@@ -229,9 +279,14 @@ def paged_flash_decode(
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale must be passed together")
     quantized = k_scale is not None
+    if quant_mxu and not quantized:
+        raise ValueError(
+            "quant_mxu needs a quantized pool (k_scale/v_scale) — the fp "
+            "pool has no low-bit payload to keep on the MXU"
+        )
     kernel = functools.partial(
         _decode_kernel, bs=bs, bps=bps, nblk=nblk, t=t, g=g,
-        sm_scale=sm_scale, quantized=quantized,
+        sm_scale=sm_scale, quantized=quantized, quant_mxu=quant_mxu,
     )
     in_specs = [
         pl.BlockSpec((None, None, tg, d), q_idx),
@@ -315,6 +370,7 @@ def paged_flash_decode_tp(
     interpret: bool | None = None,
     k_scale: jax.Array | None = None,  # (num_blocks, bs, NKV) — quantized pool
     v_scale: jax.Array | None = None,
+    quant_mxu: bool = False,
 ) -> jax.Array:
     """:func:`paged_flash_decode` sharded over the tensor-parallel mesh.
 
@@ -366,6 +422,10 @@ def paged_flash_decode_tp(
     # check_vma off: pallas_call carries no replication rule on either jax
     # generation; the per-rank outputs are genuinely tp-varying anyway
     if k_scale is None:
+        if quant_mxu:
+            raise ValueError(
+                "quant_mxu needs a quantized pool (k_scale/v_scale)"
+            )
         def local(qs, ks, vs, tbl, pos):
             return paged_flash_decode(
                 qs, ks, vs, tbl, pos,
@@ -388,6 +448,7 @@ def paged_flash_decode_tp(
         return paged_flash_decode(
             qs, ks, vs, tbl, pos, k_scale=kss, v_scale=vss,
             kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
+            quant_mxu=quant_mxu,
         )
 
     return compat.shard_map(

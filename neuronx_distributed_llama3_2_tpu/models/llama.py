@@ -97,6 +97,12 @@ class LlamaConfig:
     # the (t * group) tile height; tree-masked blocks and longer prefill
     # buckets keep the dense gather
     paged_kernel_max_t: int = 8
+    # low-precision MXU q·k in the paged kernel (quantized pool only): the
+    # int8/fp8 payload stays a dot operand (int8×int8→int32 accumulate /
+    # fp8 preferred_element_type=f32) and the absmax scales multiply the
+    # fp32 score outputs instead of dequant-widening before the dot; off,
+    # the kernel widens to fp32 first (the graftcheck GC005 contract)
+    quant_mxu: bool = False
     # chunk the LM head + CE over the sequence so full (B,S,V) logits never
     # materialize; None disables (loss-memory redesign, no reference analogue)
     loss_chunk_size: Optional[int] = None

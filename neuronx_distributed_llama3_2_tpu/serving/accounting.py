@@ -119,6 +119,8 @@ class EngineDims:
     kv_bytes_per_elem: int
     scale_bytes: int             # per-(row, kv-head) scale bytes, 0 if bf16
     tp_size: int
+    quant_mxu: bool = False      # int8 q·k dot on the MXU (config.quant_mxu)
+    fused_sampling: bool = False  # per-lane sampling residents in lane_set
 
     @classmethod
     def from_engine(cls, engine: Any) -> "EngineDims":
@@ -150,6 +152,8 @@ class EngineDims:
             kv_bytes_per_elem=engine.cache.k.dtype.itemsize,
             scale_bytes=kv_scale_itemsize(engine.paged.kv_cache_dtype),
             tp_size=max(int(engine.metrics.tp_size), 1),
+            quant_mxu=bool(getattr(engine.model.config, "quant_mxu", False)),
+            fused_sampling=bool(getattr(engine, "_fused", False)),
         )
 
     @property
@@ -171,10 +175,19 @@ class EngineDims:
         return 2 * self.num_layers * self.kv_heads_local * per_head
 
 
-def _flops_per_token(dims: EngineDims, context: int) -> float:
-    return flops_mod.decode_flops_per_token(
+def _flops_per_token(
+    dims: EngineDims, context: int, quant_mxu: bool = False
+) -> float:
+    f = flops_mod.decode_flops_per_token(
         dims.num_params, dims.num_layers, dims.hidden_size, max(context, 1)
     )
+    if quant_mxu:
+        # the q·kᵀ half of the attention term (2·L·H·K of the 4·L·H·K)
+        # runs as an int8 MXU dot at twice bf16 throughput — charge it
+        # at half its bf16-equivalent cost, so MFU normalization keeps
+        # comparing against the bf16 peak the roofline is stated in
+        f -= dims.num_layers * dims.hidden_size * max(context, 1)
+    return f
 
 
 def analytic_cost(key: tuple, dims: EngineDims) -> Tuple[float, float, str]:
@@ -201,13 +214,17 @@ def analytic_cost(key: tuple, dims: EngineDims) -> Tuple[float, float, str]:
         rows = kv
         tokens = b
     elif kind == "pdecode":
+        # the decode kernel is where quant_mxu lives: its q·k dot runs
+        # at int8 throughput, so the key's flop figure drops with it
         kv = int(key[2])
-        f = dims.max_batch * _flops_per_token(dims, kv)
+        f = dims.max_batch * _flops_per_token(dims, kv, dims.quant_mxu)
         rows = dims.max_batch * kv
         tokens = dims.max_batch
     elif kind == "pverify":
         kv, k = int(key[1]), int(key[2])
-        f = dims.max_batch * (k + 1) * _flops_per_token(dims, kv + k)
+        f = dims.max_batch * (k + 1) * _flops_per_token(
+            dims, kv + k, dims.quant_mxu
+        )
         rows = dims.max_batch * (kv + k)
         tokens = dims.max_batch * (k + 1)
     elif kind == "copy_block":
@@ -216,7 +233,11 @@ def analytic_cost(key: tuple, dims: EngineDims) -> Tuple[float, float, str]:
         return float(elems), float(2 * elems * dims.kv_bytes_per_elem), \
             "analytic-move"
     elif kind == "lane_set":
-        elems = dims.max_batch * (2 + dims.table_width)
+        # fused sampling adds 5 per-lane resident elements to the
+        # scatter: temp + top_k + top_p + the (2,) uint32 key data
+        per_lane = 2 + dims.table_width \
+            + (5 if dims.fused_sampling else 0)
+        elems = dims.max_batch * per_lane
         return float(elems), float(2 * elems * 4), "analytic-move"
     elif kind == "table_delta":
         elems = dims.max_batch * dims.table_width

@@ -143,7 +143,9 @@ class CatalogManifest:
     different programs; an engine only ever compiles one family)."""
 
     ladder: BucketLadder
-    sampling: Any  # SamplingConfig (frozen/hashable — rides inside keys)
+    # SamplingConfig (frozen/hashable — rides inside keys), or the "lane"
+    # string sentinel under fused on-device sampling
+    sampling: Any
     quantized: bool = False
     checked: bool = False
     gather_variants: bool = False
@@ -164,7 +166,13 @@ class CatalogManifest:
         )
         return cls(
             ladder=ladder,
-            sampling=engine.gen.sampling,
+            # fused on-device sampling replaces the static SamplingConfig
+            # key slot with the "lane" sentinel: per-lane params are
+            # runtime arrays, so ONE program serves every sampling config
+            sampling=(
+                "lane" if getattr(engine, "_fused", False)
+                else engine.gen.sampling
+            ),
             quantized=bool(getattr(engine, "_kv_quantized", False)),
             checked=bool(getattr(engine, "_check_logits", False)),
             gather_variants=bool(engine.paged.degrade_after_faults),
@@ -247,7 +255,10 @@ def validate_ladder(model: Any, ladder: BucketLadder) -> List[str]:
 
 
 def _format_sampling(cfg: Any) -> str:
-    """Compact, comma-free SamplingConfig rendering for key strings."""
+    """Compact, comma-free SamplingConfig rendering for key strings
+    (the fused-sampling "lane" sentinel passes through verbatim)."""
+    if isinstance(cfg, str):
+        return cfg
     if getattr(cfg, "greedy", False):
         return "greedy"
     bits = [f"T{cfg.temperature:g}"]

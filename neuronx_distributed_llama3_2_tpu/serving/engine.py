@@ -61,8 +61,10 @@ from neuronx_distributed_llama3_2_tpu.serving.faults import (
     InjectedFault,
 )
 from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+    GREEDY_TEMPERATURE,
     SamplingConfig,
     sample,
+    sample_lanes,
 )
 from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     NULL_BLOCK,
@@ -153,6 +155,25 @@ class PagedConfig:
     # chip at fixed pool bytes. "bf16" = fp passthrough: pool at the model
     # (or cache_dtype) precision, no scale arrays, trace unchanged.
     kv_cache_dtype: str = "bf16"
+    # low-precision MXU decode dot (docs/serving.md "On-device sampling &
+    # the low-precision MXU dot"): keep the quantized pool's int8/fp8
+    # payload as a q·k dot operand in the Pallas decode kernel (int8×int8
+    # accumulating int32 / fp8 with preferred_element_type=f32) and apply
+    # the absmax scales to the fp32 score outputs, instead of
+    # dequant-widening every block to fp32 before the dot. Requires a
+    # quantized kv_cache_dtype; graftcheck GC005 is knob-aware (the
+    # fp32-widening requirement applies iff this is off).
+    quant_mxu: bool = False
+    # fused on-device sampling (docs/serving.md "On-device sampling"):
+    # compile temperature/top-k/top-p + categorical INTO the decode /
+    # verify / prefill programs, with per-lane (temperature, top_k, top_p)
+    # params and per-lane PRNG key data as device-resident arrays mutated
+    # only through the lane_set scatter — sampled traffic keeps the
+    # steady-state h2d_uploads == 0 property greedy traffic has, and the
+    # greedy-only speculative guard lifts (verify's accept targets become
+    # position-keyed draws). Greedy configs ride the same program via the
+    # temperature <= 0 sentinel, token-identically to the host-key path.
+    on_device_sampling: bool = False
     metrics_log_every: int = 0  # decode steps between metric log lines; 0=off
     # chunked prefill (Sarathi-Serve): split an admission whose uncached
     # suffix exceeds this many tokens into fixed-budget chunks, one per
@@ -342,12 +363,19 @@ class PagedServingEngine:
         self._spec_k = int(paged.spec_draft_tokens or 0)
         if self._spec_k < 0:
             raise ValueError("spec_draft_tokens must be >= 0")
-        if self._spec_k and not gen.sampling.greedy:
-            # acceptance compares the target's argmax; a sampled stream
-            # would silently stop matching the plain loop
+        # fused on-device sampling (docs/serving.md "On-device sampling"):
+        # per-lane params + PRNG key data live device-resident and the
+        # decode/verify/prefill programs sample in-fuse
+        self._fused = bool(paged.on_device_sampling)
+        if self._spec_k and not gen.sampling.greedy and not self._fused:
+            # host-sampled acceptance compares the target's argmax; a
+            # sampled stream would silently stop matching the plain loop.
+            # Fused sampling lifts this: verify's accept targets become
+            # position-keyed draws (LlamaDecode.verify_step sampling=).
             raise ValueError(
-                "speculative serving requires greedy sampling "
-                "(SamplingConfig(greedy=True))"
+                "speculative serving with host sampling requires greedy "
+                "(SamplingConfig(greedy=True)) — or turn on "
+                "PagedConfig.on_device_sampling for sampled verify"
             )
         self.drafter = drafter
         if self._spec_k and self.drafter is None:
@@ -401,6 +429,22 @@ class PagedServingEngine:
                 "cache_dtype and a quantized kv_cache_dtype are mutually "
                 "exclusive — the quantized storage dtype IS the pool dtype"
             )
+        if paged.quant_mxu:
+            if not self._kv_quantized:
+                raise ValueError(
+                    "quant_mxu requires a quantized kv_cache_dtype "
+                    "(int8/fp8) — the fp pool has no low-bit payload to "
+                    "keep on the MXU"
+                )
+            if not getattr(self.model.config, "quant_mxu", False):
+                # config twin carrying the kernel knob (same weightless
+                # pattern as the kernel-shed gather twin): every program
+                # traced below binds the low-precision-dot model, so the
+                # engine IS the knob's scope — the caller's model object
+                # is untouched
+                self.model = type(self.model)(
+                    dataclasses.replace(self.model.config, quant_mxu=True)
+                )
         self.cache = self.model.init_paged_cache(
             paged.num_blocks, bs, paged.cache_dtype,
             kv_cache_dtype=paged.kv_cache_dtype,
@@ -528,6 +572,25 @@ class PagedServingEngine:
         self._d_tokens = self._pin(jnp.asarray(self._tokens))
         self._d_positions = self._pin(jnp.asarray(self._positions))
         self._d_tables = self._pin(jnp.asarray(self._tables))
+        # fused-sampling residents (PagedConfig.on_device_sampling): the
+        # per-lane sampling params + raw PRNG key data ride next to
+        # tokens/positions/tables — scattered by the same lane_set
+        # program, consumed by every fused dispatch, never re-uploaded per
+        # step. temperature <= 0 (GREEDY_TEMPERATURE) is the idle/greedy
+        # sentinel; key data is raw uint32 because typed key arrays cannot
+        # ride a donated scatter.
+        self._temps = np.full(
+            (engine.max_batch,), GREEDY_TEMPERATURE, np.float32
+        )
+        self._topks = np.zeros((engine.max_batch,), np.int32)
+        self._topps = np.ones((engine.max_batch,), np.float32)
+        self._rng = np.zeros((engine.max_batch, 2), np.uint32)
+        self._d_temps = self._d_topks = self._d_topps = self._d_rng = None
+        if self._fused:
+            self._d_temps = self._pin(jnp.asarray(self._temps))
+            self._d_topks = self._pin(jnp.asarray(self._topks))
+            self._d_topps = self._pin(jnp.asarray(self._topps))
+            self._d_rng = self._pin(jnp.asarray(self._rng))
         # advanced positions are clamped here: keeps a long-idle garbage
         # lane's position inside the rope table (see LlamaDecode.decode_step)
         self._pos_cap = self.table_width * bs - 1
@@ -737,17 +800,26 @@ class PagedServingEngine:
         """Program-cache key bit for the kernel-shed rung."""
         return self._step_model() is not self.model
 
-    def _prefill_ctx_program(self, bucket: int, cfg: SamplingConfig):
+    def _decode_cfg(self):
+        """The sampling slot of pctx/psfx/pdecode program keys: the static
+        :class:`SamplingConfig` on the host-sampling path, the literal
+        ``"lane"`` sentinel under fused on-device sampling — per-lane
+        params are runtime arrays there, so ONE compiled program serves
+        every sampling config (and the catalog shrinks accordingly)."""
+        return "lane" if self._fused else self.gen.sampling
+
+    def _prefill_ctx_program(self, bucket: int, cfg):
         """Whole-prompt prefill (no cached prefix): context-encode forward +
-        last-token gather + on-device sample, paged writes."""
+        last-token gather + on-device sample, paged writes. Under fused
+        sampling (``cfg == "lane"``) the host PRNG key argument is replaced
+        by the admitted request's (1, 2) key data + (1,) sampling params and
+        the draw is keyed by the landing index (= the prefilled length)."""
         key_ = ("pctx", bucket, cfg, self._gather_shed())
         if key_ in self._programs:
             return self._programs[key_]
         model, engine = self._step_model(), self.engine
 
-        def fn(params, cache, ids, length, table, key):
-            params = engine._live_params(params)
-            positions = jnp.zeros((ids.shape[0],), jnp.int32)
+        def _last_logits(params, cache, ids, positions, length, table):
             hidden, cache = model.forward(
                 params, cache, ids, positions, None,
                 context_encode=True, return_hidden=True, block_tables=table,
@@ -755,28 +827,48 @@ class PagedServingEngine:
             last = jnp.take_along_axis(
                 hidden, (length - 1)[:, None, None], axis=1
             )
-            logits = model._model()._logits(params, last)[:, 0, :]
-            return sample(logits, key, cfg), cache
+            return model._model()._logits(params, last)[:, 0, :], cache
+
+        if self._fused:
+            def fn(params, cache, ids, length, table, rng, temp, topk, topp):
+                params = engine._live_params(params)
+                positions = jnp.zeros((ids.shape[0],), jnp.int32)
+                logits, cache = _last_logits(
+                    params, cache, ids, positions, length, table
+                )
+                # the sampled token lands at sequence index `length` —
+                # the same fold_in index a decode step at position
+                # length - 1 would use, so resume replays identically
+                tok = sample_lanes(logits, rng, length, temp, topk, topp)
+                return tok, cache
+        else:
+            def fn(params, cache, ids, length, table, key):
+                params = engine._live_params(params)
+                positions = jnp.zeros((ids.shape[0],), jnp.int32)
+                logits, cache = _last_logits(
+                    params, cache, ids, positions, length, table
+                )
+                return sample(logits, key, cfg), cache
 
         return self._register_program(
             key_, fn, donate_argnums=(1,), kind="pctx",
             gather=self._gather_shed(), bucket=bucket,
         )
 
-    def _prefill_suffix_program(
-        self, bucket: int, kv_limit: int, cfg: SamplingConfig
-    ):
+    def _prefill_suffix_program(self, bucket: int, kv_limit: int, cfg):
         """Suffix prefill after a prefix-cache hit: the fresh block starts at
         position ``start`` (the cached length) and attends over the shared
         prefix blocks through the table — the cached tokens are never
-        recomputed."""
+        recomputed. Fused sampling keys the draw by ``start + length`` (the
+        landing index of the sampled token); non-final chunked-prefill
+        dispatches discard their token, so only the final chunk's index —
+        the total committed length — ever reaches a stream."""
         key_ = ("psfx", bucket, kv_limit, cfg, self._gather_shed())
         if key_ in self._programs:
             return self._programs[key_]
         model, engine = self._step_model(), self.engine
 
-        def fn(params, cache, ids, start, length, table, key):
-            params = engine._live_params(params)
+        def _last_logits(params, cache, ids, start, length, table):
             hidden, cache = model.forward(
                 params, cache, ids, start, None,
                 return_hidden=True, block_tables=table, kv_limit=kv_limit,
@@ -784,15 +876,33 @@ class PagedServingEngine:
             last = jnp.take_along_axis(
                 hidden, (length - 1)[:, None, None], axis=1
             )
-            logits = model._model()._logits(params, last)[:, 0, :]
-            return sample(logits, key, cfg), cache
+            return model._model()._logits(params, last)[:, 0, :], cache
+
+        if self._fused:
+            def fn(params, cache, ids, start, length, table,
+                   rng, temp, topk, topp):
+                params = engine._live_params(params)
+                logits, cache = _last_logits(
+                    params, cache, ids, start, length, table
+                )
+                tok = sample_lanes(
+                    logits, rng, start + length, temp, topk, topp
+                )
+                return tok, cache
+        else:
+            def fn(params, cache, ids, start, length, table, key):
+                params = engine._live_params(params)
+                logits, cache = _last_logits(
+                    params, cache, ids, start, length, table
+                )
+                return sample(logits, key, cfg), cache
 
         return self._register_program(
             key_, fn, donate_argnums=(1,), kind="psfx",
             gather=self._gather_shed(), bucket=bucket, kv_limit=kv_limit,
         )
 
-    def _decode_program(self, cfg: SamplingConfig, kv_limit: int):
+    def _decode_program(self, cfg, kv_limit: int):
         """Resident-state decode: one T=1 step over the device-resident
         (tokens, positions, tables), returning the sampled tokens and the
         advanced positions so step N+1 can dispatch with NO host input.
@@ -805,7 +915,13 @@ class PagedServingEngine:
         chaos plan) adds a (B,) int32 poison-mask input and a (B,) bool
         ``finite`` output via ``finite_logit_check`` — detection runs on
         device and one bool per lane rides the existing readback. A
-        separate program key: the unchecked trace stays bitwise unchanged."""
+        separate program key: the unchecked trace stays bitwise unchanged.
+
+        The fused variant (``cfg == "lane"``) takes the four sampling
+        residents instead of a host PRNG key — the WHOLE argument list is
+        then device-resident, which is what makes *sampled* steady-state
+        decode genuinely zero-upload — and delegates the draw (and the
+        checked finite gate) to ``LlamaDecode.decode_step(sampling=)``."""
         checked = self._check_logits
         key_ = ("pdecode", cfg, kv_limit, self._gather_shed(), checked)
         if key_ in self._programs:
@@ -813,7 +929,25 @@ class PagedServingEngine:
         model, engine = self._step_model(), self.engine
         pos_cap = self._pos_cap
 
-        if checked:
+        if self._fused and checked:
+            def fn(params, cache, tokens, positions, tables,
+                   temp, topk, topp, rng, nan_mask):
+                params = engine._live_params(params)
+                return model.decode_step(
+                    params, cache, tokens, positions, tables,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                    sampling=(rng, temp, topk, topp), logit_poison=nan_mask,
+                )
+        elif self._fused:
+            def fn(params, cache, tokens, positions, tables,
+                   temp, topk, topp, rng):
+                params = engine._live_params(params)
+                return model.decode_step(
+                    params, cache, tokens, positions, tables,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                    sampling=(rng, temp, topk, topp),
+                )
+        elif checked:
             def fn(params, cache, tokens, positions, tables, key, nan_mask):
                 params = engine._live_params(params)
                 logits, new_positions, cache = model.decode_step(
@@ -846,7 +980,10 @@ class PagedServingEngine:
         separate (B, k) upload, the ONLY per-step host→device traffic
         speculation adds. Checked variant: poison mask in, trailing
         ``finite`` out, applied *before* the accept rule (see
-        ``LlamaDecode.verify_step``)."""
+        ``LlamaDecode.verify_step``). The fused-sampling variant appends
+        the four sampling residents and the accept targets become
+        position-keyed draws — the sampled-verify path the greedy-only
+        guard used to forbid."""
         checked = self._check_logits
         key_ = ("pverify", kv_limit, k, self._gather_shed(), checked)
         if key_ in self._programs:
@@ -854,7 +991,27 @@ class PagedServingEngine:
         model, engine = self._step_model(), self.engine
         pos_cap = self._pos_cap
 
-        if checked:
+        if self._fused and checked:
+            def fn(params, cache, tokens, positions, tables, drafts,
+                   draft_len, temp, topk, topp, rng, nan_mask):
+                params = engine._live_params(params)
+                block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+                return model.verify_step(
+                    params, cache, block, positions, tables, draft_len,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                    sampling=(rng, temp, topk, topp), logit_poison=nan_mask,
+                )
+        elif self._fused:
+            def fn(params, cache, tokens, positions, tables, drafts,
+                   draft_len, temp, topk, topp, rng):
+                params = engine._live_params(params)
+                block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+                return model.verify_step(
+                    params, cache, block, positions, tables, draft_len,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                    sampling=(rng, temp, topk, topp),
+                )
+        elif checked:
             def fn(params, cache, tokens, positions, tables, drafts,
                    draft_len, nan_mask):
                 params = engine._live_params(params)
@@ -884,10 +1041,33 @@ class PagedServingEngine:
         finish / preemption path. All three residents are donated, so the
         update is an in-place dynamic-update-slice, not a reallocation.
         Only legal while no lookahead step is in flight (the donated token
-        buffer could be the pending readback)."""
+        buffer could be the pending readback).
+
+        Under fused sampling the same key scatters SEVEN residents — the
+        per-lane sampling params and PRNG key data mutate ONLY through
+        this donated path, which is what keeps sampled steady-state
+        dispatches upload-free."""
         key_ = ("lane_set",)
         if key_ in self._programs:
             return self._programs[key_]
+
+        if self._fused:
+            def fn(tokens, positions, tables, temps, topks, topps, rng,
+                   lane, tok, pos, trow, temp, topk, topp, rg):
+                return (
+                    tokens.at[lane].set(tok),
+                    positions.at[lane].set(pos),
+                    tables.at[lane].set(trow),
+                    temps.at[lane].set(temp),
+                    topks.at[lane].set(topk),
+                    topps.at[lane].set(topp),
+                    rng.at[lane].set(rg),
+                )
+
+            return self._register_program(
+                key_, fn, donate_argnums=(0, 1, 2, 3, 4, 5, 6),
+                kind="lane_set",
+            )
 
         def fn(tokens, positions, tables, lane, tok, pos, trow):
             return (
@@ -953,6 +1133,76 @@ class PagedServingEngine:
             self.tracer.complete("readback", t0, t1, n=int(arr.size))
         return arr
 
+    # -- fused-sampling lane state (PagedConfig.on_device_sampling) --------
+
+    def _lane_rng(self, rid: int) -> np.ndarray:
+        """Per-request base PRNG key data (2,) uint32, derived from
+        ``(gen.seed, rid)`` via SeedSequence: a preempted request
+        re-installs the SAME key on re-admission, and with every draw
+        keyed by its landing index (``sample_lanes``' fold_in discipline)
+        the resumed stream replays the unpreempted run token for token."""
+        return np.random.SeedSequence(
+            [int(self.gen.seed), int(rid)]
+        ).generate_state(2).astype(np.uint32)
+
+    def _sampling_mode(self) -> str:
+        """Tracer label + counter bucket for a decode/verify dispatch:
+        ``"greedy"`` (argmax — either engine mode), ``"fused"`` (on-device
+        sampled draw from the residents), or ``"host"`` (host-keyed
+        sampled draw, the upload-paying fallback)."""
+        if self.gen.sampling.greedy:
+            return "greedy"
+        return "fused" if self._fused else "host"
+
+    def _note_sampling_dispatch(self) -> str:
+        mode = self._sampling_mode()
+        if mode == "fused":
+            self.metrics.sampled_steps += 1
+        elif mode == "host":
+            self.metrics.host_sample_fallbacks += 1
+        return mode
+
+    def _install_lane_sampling(self, lane: int, req: _PagedRequest) -> None:
+        """Admission-time host-mirror install of a lane's sampling params
+        and base key (pushed to device by the next lane_set flush). A
+        greedy GenerationConfig installs the temperature sentinel, so the
+        fused program reduces to exact argmax for the lane."""
+        if not self._fused:
+            return
+        s = self.gen.sampling
+        if s.greedy:
+            self._temps[lane] = GREEDY_TEMPERATURE
+            self._topks[lane] = 0
+            self._topps[lane] = 1.0
+        else:
+            self._temps[lane] = s.temperature
+            self._topks[lane] = s.top_k
+            self._topps[lane] = s.top_p
+        self._rng[lane] = self._lane_rng(req.rid)
+        self.metrics.rng_reseeds += 1
+
+    def _clear_lane_sampling(self, lane: int) -> None:
+        """Teardown twin of :meth:`_install_lane_sampling`: park the lane
+        at the greedy sentinel with a null key — idle lanes keep stepping
+        in the resident batch, and argmax is the cheapest garbage draw."""
+        if not self._fused:
+            return
+        self._temps[lane] = GREEDY_TEMPERATURE
+        self._topks[lane] = 0
+        self._topps[lane] = 1.0
+        self._rng[lane] = 0
+
+    def _lane_sampling_args(self, lane: int) -> tuple:
+        """``(rng (1, 2), temp (1,), topk (1,), topp (1,))`` uploads for a
+        fused prefill dispatch — prefill pays per-call uploads anyway
+        (ids/length/table); only decode/verify must stay resident-only."""
+        return (
+            self._upload(self._rng[lane: lane + 1], jnp.uint32),
+            self._upload(self._temps[lane: lane + 1], jnp.float32),
+            self._upload(self._topks[lane: lane + 1], jnp.int32),
+            self._upload(self._topps[lane: lane + 1], jnp.float32),
+        )
+
     # -- fault handling (docs/serving.md "Failure handling & degradation") --
 
     def _chaos_device(self, site: str, lanes: Sequence[int]) -> None:
@@ -1017,6 +1267,7 @@ class PagedServingEngine:
             self._tables[lane, :] = NULL_BLOCK
             self._tokens[lane] = 0
             self._positions[lane] = 0
+            self._clear_lane_sampling(lane)
             self._dirty_lanes.add(lane)
             req.lane = None
         self._finished[req.rid] = req
@@ -1210,13 +1461,28 @@ class PagedServingEngine:
         eng = self.engine
         key = jax.random.key(0)
         zeros_b = jnp.zeros((eng.max_batch,), jnp.int32)
+        # fused-sampling trailing args: decode consumes THE residents
+        # (same committed arrays traffic dispatches), prefill takes aval
+        # twins of the per-admission (1,·) sampling uploads
+        d_tail = (
+            (self._d_temps, self._d_topks, self._d_topps, self._d_rng)
+            if self._fused else (key,)
+        )
+        p_tail = (
+            (
+                jnp.zeros((1, 2), jnp.uint32), jnp.zeros((1,), jnp.float32),
+                jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.float32),
+            )
+            if self._fused else (key,)
+        )
         for kv in self._kv_buckets:
-            fn = self._decode_program(self.gen.sampling, kv)
+            fn = self._decode_program(self._decode_cfg(), kv)
             # positions are donated per call — hand each warmup its own
             # throwaway array; the resident state itself is untouched
             args = (
                 eng.params, self.cache, zeros_b,
-                jnp.zeros((eng.max_batch,), jnp.int32), self._d_tables, key,
+                jnp.zeros((eng.max_batch,), jnp.int32), self._d_tables,
+                *d_tail,
             )
             if self._check_logits:
                 _, _, _, self.cache = fn(*args, self._nan_mask((), "warmup"))
@@ -1224,10 +1490,10 @@ class PagedServingEngine:
                 _, _, self.cache = fn(*args)
         table1 = jnp.full((1, self.table_width), NULL_BLOCK, jnp.int32)
         for bucket in eng.buckets:
-            fn = self._prefill_ctx_program(bucket, self.gen.sampling)
+            fn = self._prefill_ctx_program(bucket, self._decode_cfg())
             _, self.cache = fn(
                 eng.params, self.cache, jnp.zeros((1, bucket), jnp.int32),
-                jnp.ones((1,), jnp.int32), table1, key,
+                jnp.ones((1,), jnp.int32), table1, *p_tail,
             )
 
     def prewarm(self) -> None:
@@ -1249,6 +1515,25 @@ class PagedServingEngine:
             zeros_b = jnp.zeros((eng.max_batch,), jnp.int32)
             table1 = jnp.full((1, self.table_width), NULL_BLOCK, jnp.int32)
             zero = jnp.asarray(0, jnp.int32)
+            # fused-sampling trailing args (aval twins of traffic's):
+            # decode/verify dispatch THE residents, prefill the (1,·)
+            # per-admission sampling uploads. d_tail is a THUNK: the
+            # lane_set arm donates and replaces the resident buffers, so
+            # binding them once would hand pdecode/pverify deleted arrays.
+            def d_tail() -> tuple:
+                return (
+                    (self._d_temps, self._d_topks, self._d_topps, self._d_rng)
+                    if self._fused else (key,)
+                )
+            p_tail = (
+                (
+                    jnp.zeros((1, 2), jnp.uint32),
+                    jnp.zeros((1,), jnp.float32),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.ones((1,), jnp.float32),
+                )
+                if self._fused else (key,)
+            )
             for key_ in self.catalog.prewarm_keys():
                 kind = key_[0]
                 if kind == "copy_block":
@@ -1256,13 +1541,33 @@ class PagedServingEngine:
                     self.cache = self._copy_block_fn(self.cache, zero, zero)
                 elif kind == "lane_set":
                     # rewrite lane 0's resident state with its current
-                    # values (zeros + all-null table row)
+                    # values (zeros + all-null table row; under fused
+                    # sampling also the sentinel params + null key data)
                     fn = self._lane_set_program()
-                    self._d_tokens, self._d_positions, self._d_tables = fn(
-                        self._d_tokens, self._d_positions, self._d_tables,
-                        zero, zero, zero,
-                        jnp.full((self.table_width,), NULL_BLOCK, jnp.int32),
+                    trow = jnp.full(
+                        (self.table_width,), NULL_BLOCK, jnp.int32
                     )
+                    if self._fused:
+                        (
+                            self._d_tokens, self._d_positions,
+                            self._d_tables, self._d_temps, self._d_topks,
+                            self._d_topps, self._d_rng,
+                        ) = fn(
+                            self._d_tokens, self._d_positions,
+                            self._d_tables, self._d_temps, self._d_topks,
+                            self._d_topps, self._d_rng,
+                            zero, zero, zero, trow,
+                            jnp.asarray(
+                                GREEDY_TEMPERATURE, jnp.float32
+                            ),
+                            zero, jnp.asarray(1.0, jnp.float32),
+                            jnp.zeros((2,), jnp.uint32),
+                        )
+                    else:
+                        self._d_tokens, self._d_positions, self._d_tables = fn(
+                            self._d_tokens, self._d_positions, self._d_tables,
+                            zero, zero, zero, trow,
+                        )
                 elif kind == "table_delta":
                     fn = self._table_delta_program()
                     self._d_tables = fn(
@@ -1275,7 +1580,7 @@ class PagedServingEngine:
                     _, self.cache = fn(
                         eng.params, self.cache,
                         jnp.zeros((1, bucket), jnp.int32),
-                        jnp.ones((1,), jnp.int32), table1, key,
+                        jnp.ones((1,), jnp.int32), table1, *p_tail,
                     )
                 elif kind == "psfx":
                     _, bucket, kv, cfg, _g = key_
@@ -1284,7 +1589,7 @@ class PagedServingEngine:
                         eng.params, self.cache,
                         jnp.zeros((1, bucket), jnp.int32),
                         jnp.ones((1,), jnp.int32),
-                        jnp.ones((1,), jnp.int32), table1, key,
+                        jnp.ones((1,), jnp.int32), table1, *p_tail,
                     )
                 elif kind == "pdecode":
                     _, cfg, kv, _g, _c = key_
@@ -1296,7 +1601,7 @@ class PagedServingEngine:
                     # admission's lane_set rewrites the lane state anyway
                     args = (
                         eng.params, self.cache, self._d_tokens,
-                        self._d_positions, self._d_tables, key,
+                        self._d_positions, self._d_tables, *d_tail(),
                     )
                     if self._check_logits:
                         toks, _, self._d_positions, self.cache = fn(
@@ -1312,6 +1617,7 @@ class PagedServingEngine:
                         eng.params, self.cache, self._d_tokens,
                         self._d_positions, self._d_tables,
                         jnp.zeros((eng.max_batch, k), jnp.int32), zeros_b,
+                        *(d_tail() if self._fused else ()),
                     )
                     if self._check_logits:
                         _, _, toks, self._d_positions, _, self.cache = fn(
@@ -1447,6 +1753,9 @@ class PagedServingEngine:
             req.cached_tokens += cached
             self._tables[lane, :] = NULL_BLOCK
             self._active[lane] = req
+            # fused sampling: (re-)install the lane's params + base key
+            # before any prefill of this admission can draw from them
+            self._install_lane_sampling(lane, req)
             self.metrics.admitted += 1
             self.metrics.cached_tokens += cached
             if req.admitted_at is None:  # queue_ms = first admission wait
@@ -1470,11 +1779,13 @@ class PagedServingEngine:
                 self._dirty_lanes.add(lane)
                 continue
             suffix = seq[cached:]
-            self._key, k = jax.random.split(self._key)
+            k = None
+            if not self._fused:
+                self._key, k = jax.random.split(self._key)
             t_p = time.perf_counter()
             try:
                 self._chaos_device("prefill", (lane,))
-                first = self._prefill(suffix, cached, table, k)
+                first = self._prefill(suffix, cached, table, k, lane=lane)
             except InjectedFault as fault:
                 # admission prefill fault: only this request dies — its
                 # lane/table teardown leaves the admission wave consistent
@@ -1509,12 +1820,14 @@ class PagedServingEngine:
 
     def _prefill(
         self, suffix: List[int], cached: int, table: List[int], key,
-        table_dev=None,
+        table_dev=None, lane: Optional[int] = None,
     ) -> int:
         """Run one (whole or chunk) prefill and read its sampled token back.
         ``table_dev`` short-circuits the per-call block-table upload —
         chunked prefill passes the same (1, W) device array for every chunk
-        of an admission instead of re-uploading it each time."""
+        of an admission instead of re-uploading it each time. Under fused
+        sampling ``key`` is None and ``lane`` selects the installed
+        sampling mirrors that ride in as the (1,·) trailing uploads."""
         eng = self.engine
         bucket = pick_bucket(self._prefill_buckets, max(len(suffix), 1))
         self._last_prefill_bucket = bucket  # tracer pad-waste tag
@@ -1525,19 +1838,22 @@ class PagedServingEngine:
             tbl = np.full((1, self.table_width), NULL_BLOCK, np.int32)
             tbl[0, : len(table)] = table
             table_dev = self._upload(tbl)
+        tail = self._lane_sampling_args(lane) if self._fused else (key,)
         if cached == 0:
-            fn = self._prefill_ctx_program(bucket, self.gen.sampling)
+            fn = self._prefill_ctx_program(bucket, self._decode_cfg())
             tok, self.cache = fn(
                 eng.params, self.cache, self._upload(ids),
-                self._upload(length), table_dev, key,
+                self._upload(length), table_dev, *tail,
             )
         else:
             kv_limit = self._kv_bucket(min(cached + bucket, eng.max_seq_len))
-            fn = self._prefill_suffix_program(bucket, kv_limit, self.gen.sampling)
+            fn = self._prefill_suffix_program(
+                bucket, kv_limit, self._decode_cfg()
+            )
             tok, self.cache = fn(
                 eng.params, self.cache, self._upload(ids),
                 self._upload(np.asarray([cached], np.int32)),
-                self._upload(length), table_dev, key,
+                self._upload(length), table_dev, *tail,
             )
         # graftmeter pad-waste fold: every prefill (admission or chunk)
         # funnels through here with `fn` bound to the dispatched program
@@ -1565,7 +1881,9 @@ class PagedServingEngine:
             start = req.prefill_pos
             piece = seq[start: start + chunk]
             final = start + len(piece) >= req.prefill_target
-            self._key, k = jax.random.split(self._key)
+            k = None
+            if not self._fused:
+                self._key, k = jax.random.split(self._key)
             if req.table_dev is None:
                 # one upload for the whole chunk walk: the admission
                 # allocated the full table, so every chunk sees the same row
@@ -1575,7 +1893,9 @@ class PagedServingEngine:
             t_p = time.perf_counter()
             try:
                 self._chaos_device("prefill", (lane,))
-                tok = self._prefill(piece, start, req.table, k, req.table_dev)
+                tok = self._prefill(
+                    piece, start, req.table, k, req.table_dev, lane=lane
+                )
             except InjectedFault as fault:
                 # chunk fault: this lane's prefill walk dies, the other
                 # prefilling/decoding lanes are untouched
@@ -1637,6 +1957,7 @@ class PagedServingEngine:
         self._tables[lane, :] = NULL_BLOCK
         self._tokens[lane] = 0
         self._positions[lane] = 0
+        self._clear_lane_sampling(lane)
         self._dirty_lanes.add(lane)
         self._queue.insert(0, req)
         req.preemptions += 1
@@ -1734,6 +2055,7 @@ class PagedServingEngine:
             self._tables[lane, :] = NULL_BLOCK
             self._tokens[lane] = 0
             self._positions[lane] = 0
+            self._clear_lane_sampling(lane)
             self._dirty_lanes.add(lane)
             req.lane = None
         self._finished[req.rid] = req
@@ -1773,13 +2095,32 @@ class PagedServingEngine:
             ):
                 fn = self._lane_set_program()
                 for lane in sorted(self._dirty_lanes):
-                    self._d_tokens, self._d_positions, self._d_tables = fn(
-                        self._d_tokens, self._d_positions, self._d_tables,
-                        self._upload(lane),
-                        self._upload(self._tokens[lane]),
-                        self._upload(self._positions[lane]),
-                        self._upload(self._tables[lane]),
-                    )
+                    if self._fused:
+                        (
+                            self._d_tokens, self._d_positions,
+                            self._d_tables, self._d_temps, self._d_topks,
+                            self._d_topps, self._d_rng,
+                        ) = fn(
+                            self._d_tokens, self._d_positions,
+                            self._d_tables, self._d_temps, self._d_topks,
+                            self._d_topps, self._d_rng,
+                            self._upload(lane),
+                            self._upload(self._tokens[lane]),
+                            self._upload(self._positions[lane]),
+                            self._upload(self._tables[lane]),
+                            self._upload(self._temps[lane], jnp.float32),
+                            self._upload(self._topks[lane]),
+                            self._upload(self._topps[lane], jnp.float32),
+                            self._upload(self._rng[lane], jnp.uint32),
+                        )
+                    else:
+                        self._d_tokens, self._d_positions, self._d_tables = fn(
+                            self._d_tokens, self._d_positions, self._d_tables,
+                            self._upload(lane),
+                            self._upload(self._tokens[lane]),
+                            self._upload(self._positions[lane]),
+                            self._upload(self._tables[lane]),
+                        )
                     self.metrics.lane_syncs += 1
                 self._dirty_lanes.clear()
 
@@ -1876,30 +2217,39 @@ class PagedServingEngine:
         eng = self.engine
         kv_need = int(max(self._positions[l] for l in decode_lanes)) + 1
         kv_limit = self._kv_bucket(kv_need)
-        fn = self._decode_program(self.gen.sampling, kv_limit)
+        fn = self._decode_program(self._decode_cfg(), kv_limit)
         self.metrics.note_decode_dispatch(
             kv_limit, kv_need,
             *(self._flops_by_key.get(fn.key) or (0.0, 0.0)),
         )
-        self._key, k = jax.random.split(self._key)
+        if self._fused:
+            # the ENTIRE argument list is device-resident: sampled traffic
+            # dispatches with the same zero uploads greedy traffic does
+            args = (
+                eng.params, self.cache, self._d_tokens, self._d_positions,
+                self._d_tables, self._d_temps, self._d_topks,
+                self._d_topps, self._d_rng,
+            )
+        else:
+            self._key, k = jax.random.split(self._key)
+            args = (
+                eng.params, self.cache, self._d_tokens, self._d_positions,
+                self._d_tables, k,
+            )
+        smode = self._note_sampling_dispatch()
         tr = self.tracer
         t_d = tr.now() if tr.enabled else 0.0
         finite = None
         if self._check_logits:
             toks, finite, self._d_positions, self.cache = fn(
-                eng.params, self.cache,
-                self._d_tokens, self._d_positions, self._d_tables, k,
-                self._nan_mask(decode_lanes, "decode"),
+                *args, self._nan_mask(decode_lanes, "decode"),
             )
         else:
-            toks, self._d_positions, self.cache = fn(
-                eng.params, self.cache,
-                self._d_tokens, self._d_positions, self._d_tables, k,
-            )
+            toks, self._d_positions, self.cache = fn(*args)
         if tr.enabled:
             tr.complete(
                 "dispatch", t_d, program=program_label(fn), mode="async",
-                lanes=len(decode_lanes), kv_bucket=kv_limit,
+                sampling=smode, lanes=len(decode_lanes), kv_bucket=kv_limit,
                 kv_pad=kv_limit - kv_need,
             )
         self._d_tokens = toks
@@ -1942,30 +2292,39 @@ class PagedServingEngine:
         eng = self.engine
         kv_need = int(max(self._positions[l] for l in decode_lanes)) + 1
         kv_limit = self._kv_bucket(kv_need)
-        fn = self._decode_program(self.gen.sampling, kv_limit)
+        fn = self._decode_program(self._decode_cfg(), kv_limit)
         self.metrics.note_decode_dispatch(
             kv_limit, kv_need,
             *(self._flops_by_key.get(fn.key) or (0.0, 0.0)),
         )
-        self._key, k = jax.random.split(self._key)
+        if self._fused:
+            # the ENTIRE argument list is device-resident: sampled traffic
+            # dispatches with the same zero uploads greedy traffic does
+            args = (
+                eng.params, self.cache, self._d_tokens, self._d_positions,
+                self._d_tables, self._d_temps, self._d_topks,
+                self._d_topps, self._d_rng,
+            )
+        else:
+            self._key, k = jax.random.split(self._key)
+            args = (
+                eng.params, self.cache, self._d_tokens, self._d_positions,
+                self._d_tables, k,
+            )
+        smode = self._note_sampling_dispatch()
         tr = self.tracer
         t_d = tr.now() if tr.enabled else 0.0
         finite = None
         if self._check_logits:
             toks, finite, self._d_positions, self.cache = fn(
-                eng.params, self.cache,
-                self._d_tokens, self._d_positions, self._d_tables, k,
-                self._nan_mask(decode_lanes, "decode"),
+                *args, self._nan_mask(decode_lanes, "decode"),
             )
         else:
-            toks, self._d_positions, self.cache = fn(
-                eng.params, self.cache,
-                self._d_tokens, self._d_positions, self._d_tables, k,
-            )
+            toks, self._d_positions, self.cache = fn(*args)
         if tr.enabled:
             tr.complete(
                 "dispatch", t_d, program=program_label(fn), mode="sync",
-                lanes=len(decode_lanes), kv_bucket=kv_limit,
+                sampling=smode, lanes=len(decode_lanes), kv_bucket=kv_limit,
                 kv_pad=kv_limit - kv_need,
             )
         self._d_tokens = toks
@@ -2084,29 +2443,35 @@ class PagedServingEngine:
             kv_limit, kv_need,
             *(self._flops_by_key.get(fn.key) or (0.0, 0.0)),
         )
+        smode = self._note_sampling_dispatch()
         tr = self.tracer
         t_d = tr.now() if tr.enabled else 0.0
+        args = (
+            eng.params, self.cache,
+            self._d_tokens, self._d_positions, self._d_tables,
+            self._upload(drafts), self._upload(draft_len),
+        )
+        if self._fused:
+            # sampled verify: accept targets become position-keyed draws
+            # from the same residents plain decode samples with
+            args += (
+                self._d_temps, self._d_topks, self._d_topps, self._d_rng,
+            )
         if self._check_logits:
             (
                 emitted_d, accept_d, new_tokens, self._d_positions,
                 finite_d, self.cache,
-            ) = fn(
-                eng.params, self.cache,
-                self._d_tokens, self._d_positions, self._d_tables,
-                self._upload(drafts), self._upload(draft_len),
-                self._nan_mask(decode_lanes, "verify"),
-            )
+            ) = fn(*args, self._nan_mask(decode_lanes, "verify"))
         else:
             finite_d = None
-            emitted_d, accept_d, new_tokens, self._d_positions, self.cache = fn(
-                eng.params, self.cache,
-                self._d_tokens, self._d_positions, self._d_tables,
-                self._upload(drafts), self._upload(draft_len),
+            emitted_d, accept_d, new_tokens, self._d_positions, self.cache = (
+                fn(*args)
             )
         if tr.enabled:
             tr.complete(
                 "dispatch", t_d, program=program_label(fn), mode="verify",
-                lanes=len(decode_lanes), drafts=int(draft_len.sum()),
+                sampling=smode, lanes=len(decode_lanes),
+                drafts=int(draft_len.sum()),
                 kv_bucket=kv_limit, kv_pad=kv_limit - kv_need,
             )
         self._d_tokens = new_tokens

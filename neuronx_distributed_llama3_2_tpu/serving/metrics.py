@@ -71,6 +71,11 @@ class ServingMetrics:
     lane_syncs: int = 0          # full-lane host→device resident-state pushes
     table_deltas: int = 0        # single-entry block-table scatter updates
     h2d_uploads: int = 0         # host→device array uploads on the serving path
+    # -- on-device sampling (docs/serving.md "On-device sampling") --
+    sampled_steps: int = 0         # decode/verify dispatches drawing in-fuse
+    host_sample_fallbacks: int = 0  # sampled dispatches that paid the host
+    #                                 PRNG-key upload (on_device_sampling off)
+    rng_reseeds: int = 0           # per-lane base-key installs at admission
     # -- step-phase timing (monotonic clock around dispatch/readback) --
     host_schedule_ms: float = 0.0  # cumulative step time minus device waits
     device_wait_ms: float = 0.0    # cumulative blocking token-readback time

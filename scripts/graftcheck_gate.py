@@ -162,7 +162,9 @@ def _verify_trace(model, params, k, b=4, kv_limit=32, nb=16, bs=8, w=8):
     )
 
 
-def _trace_rules(closed, name, model, b=4, kv_limit=32, quantized=False):
+def _trace_rules(
+    closed, name, model, b=4, kv_limit=32, quantized=False, quant_mxu=False
+):
     out = []
     out.extend(
         check_no_gather(
@@ -172,14 +174,17 @@ def _trace_rules(closed, name, model, b=4, kv_limit=32, quantized=False):
     out.extend(check_host_transfers(closed, name))
     out.extend(check_collectives(closed, name))
     if quantized:
-        out.extend(check_fp32_widening(closed, name))
+        out.extend(check_fp32_widening(closed, name, quant_mxu=quant_mxu))
     return out
 
 
 def _catalog_engine(prewarm=True):
     """The strictest single configuration the registry audit runs under:
-    int8 pool + speculative verify + chunked prefill + async lookahead,
-    prewarmed so the full manifest is compiled before first traffic."""
+    int8 pool + MXU-native int8 dot + fused on-device sampling +
+    speculative verify + chunked prefill + async lookahead, prewarmed so
+    the full manifest is compiled before first traffic. (quant_mxu makes
+    GC005's knob-aware arm load-bearing; on_device_sampling makes the
+    cfg=lane program family the audited one.)"""
     from neuronx_distributed_llama3_2_tpu.inference import (
         GenerationConfig,
         InferenceEngine,
@@ -197,6 +202,7 @@ def _catalog_engine(prewarm=True):
         GenerationConfig(max_new_tokens=6),
         PagedConfig(
             block_size=8, num_blocks=32, kv_cache_dtype="int8",
+            quant_mxu=True, on_device_sampling=True,
             spec_draft_tokens=4, prefill_chunk_tokens=6, async_loop=True,
             trace_enabled=True, trace_buffer_steps=64, prewarm=prewarm,
         ),
@@ -424,6 +430,43 @@ def entry_decode_int8():
     return _trace_rules(closed, "decode-int8", model, quantized=True)
 
 
+def entry_decode_int8_mxu():
+    """decode t=1 trace, int8 pool + ``config.quant_mxu``: the int8→int32
+    MXU dot must pass the knob-aware GC005 — and must FAIL the knob-off
+    rule (proving the permitted shape is really in the trace and the
+    rule kept its teeth for quant_mxu=False engines)."""
+    import dataclasses
+
+    from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
+
+    cfg, params = _tiny()
+    model = LlamaDecode(dataclasses.replace(cfg, quant_mxu=True))
+    cache = model.init_paged_cache(16, 8, kv_cache_dtype="int8")
+    closed = jax.make_jaxpr(
+        lambda p, c, t, ps, tb: model.decode_step(
+            p, c, t, ps, tb, kv_limit=32, pos_cap=63
+        )
+    )(
+        params, cache, jnp.zeros((4,), jnp.int32),
+        jnp.zeros((4,), jnp.int32), jnp.zeros((4, 8), jnp.int32),
+    )
+    out = _trace_rules(
+        closed, "decode-int8-mxu", model, quantized=True, quant_mxu=True
+    )
+    knob_off = check_fp32_widening(closed, "decode-int8-mxu")
+    if not any(f.rule == "GC005" for f in knob_off):
+        out.append(Finding(
+            rule="GC005", program="decode-int8-mxu",
+            message="quant_mxu trace shows no int8 dot (knob-off GC005 is "
+                    "clean) — the MXU-native path silently fell back to "
+                    "the widened dot",
+            hint="check paged_flash_decode's quant_mxu plumb-through from "
+                 "LlamaConfig.quant_mxu",
+            detail="mxu-dot-missing",
+        ))
+    return out
+
+
 def entry_verify_t1():
     """verify t=1 (k=1 draft) kernel trace."""
     from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
@@ -471,6 +514,7 @@ CATALOG = (
     ("catalog-int8", entry_catalog),
     ("decode", entry_decode),
     ("decode-int8", entry_decode_int8),
+    ("decode-int8-mxu", entry_decode_int8_mxu),
     ("verify-t1", entry_verify_t1),
     ("verify-t4", entry_verify_t4),
     ("decode-tp2", entry_decode_tp2),

@@ -39,6 +39,15 @@ Three measurements for the gather-free paged decode path (docs/serving.md):
    fitting ≥1.9× the bf16 lanes; steps/sec and the int8-vs-fp token
    agreement are reported, not gated.
 
+6. **Sampled-traffic A/B** for ``PagedConfig.on_device_sampling``: the
+   same temperature+top-k+top-p workload with the host draw (per-step key
+   upload) vs the fused on-device draw, reporting steps/sec and
+   ``h2d_uploads`` for both.  Gates: greedy outputs under the fused
+   program are identical to the host greedy engine, the fused sampled
+   run is seed-deterministic, and a decode-only steady-state window
+   records zero host->device uploads (the GC003 twin for sampled
+   traffic); the speedup column is meaningful only on a real chip.
+
 Gates (record still prints on failure, like kv_block_bench.py):
 
 - per-``kv_limit`` greedy argmax parity, kernel vs gather
@@ -649,6 +658,109 @@ def _quant_ab(config, params, args):
     }
 
 
+def _sampling_ab(config, params, args):
+    """Sampled-traffic A/B (docs/serving.md "On-device sampling").
+
+    The same sampled workload (temperature + top-k + top-p) run with
+    ``PagedConfig.on_device_sampling`` off (host draw: per-step PRNG-key
+    upload + logits download) and on (the draw fuses into the decode
+    program against the lane-resident params/key data). Reported:
+    steps/sec for both legs plus their ``h2d_uploads`` totals. Gates:
+
+    - **greedy identity**: a *greedy* run under the fused engine must be
+      token-identical to the plain greedy engine (the sentinel-params
+      argmax contract);
+    - **zero-upload steady state**: once every lane is decoding, the
+      fused sampled leg must record ZERO further host->device uploads
+      across a decode-only window (the GC003 twin for sampled traffic);
+    - **determinism**: the fused sampled run repeated with the same seed
+      must reproduce the identical token streams.
+    """
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+        SamplingConfig,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    prompts = [
+        rng.integers(0, config.vocab_size, size=(args.short_tokens,)).tolist()
+        for _ in range(args.max_batch)
+    ]
+    sampled = GenerationConfig(
+        max_new_tokens=args.max_new_tokens,
+        sampling=SamplingConfig(
+            greedy=False, temperature=0.8, top_k=40, top_p=0.9
+        ),
+    )
+    greedy = GenerationConfig(max_new_tokens=args.max_new_tokens)
+    buckets = [x for x in (8, 16, 32, 64, 128) if x <= args.max_seq_len]
+    num_blocks = 4 * (args.max_seq_len // args.block_size)
+
+    def engine(gen, fused):
+        eng = InferenceEngine(
+            config, params,
+            max_batch=args.max_batch, max_seq_len=args.max_seq_len,
+            buckets=buckets,
+        )
+        return PagedServingEngine(
+            eng, gen,
+            PagedConfig(
+                block_size=args.block_size, num_blocks=num_blocks,
+                on_device_sampling=fused,
+            ),
+        )
+
+    def run(gen, fused):
+        paged = engine(gen, fused)
+        for p in prompts:
+            paged.submit(p)
+        t0 = time.perf_counter()
+        out = paged.run_to_completion()
+        wall = time.perf_counter() - t0
+        return out, paged.metrics.decode_steps / wall, paged.metrics
+
+    out_host, sps_host, m_host = run(sampled, fused=False)
+    out_dev, sps_dev, m_dev = run(sampled, fused=True)
+    out_dev2, _, _ = run(sampled, fused=True)
+
+    # greedy identity under the fused program (sentinel params -> argmax)
+    out_g, _, _ = run(greedy, fused=False)
+    out_gf, _, _ = run(greedy, fused=True)
+
+    # zero-upload steady state: admit, drain prefills, then count uploads
+    # across a decode-only window
+    steady = engine(sampled, fused=True)
+    for p in prompts:
+        steady.submit(p)
+    for _ in range(len(prompts) + 2):
+        steady.step()
+    before = steady.metrics.h2d_uploads
+    for _ in range(3):
+        steady.step()
+    steady_uploads = steady.metrics.h2d_uploads - before
+
+    return {
+        "sampling_host_steps_per_s": round(sps_host, 2),
+        "sampling_fused_steps_per_s": round(sps_dev, 2),
+        "sampling_host_h2d_uploads": int(m_host.h2d_uploads),
+        "sampling_fused_h2d_uploads": int(m_dev.h2d_uploads),
+        "sampling_host_fallback_steps": int(m_host.host_sample_fallbacks),
+        "sampling_fused_sampled_steps": int(m_dev.sampled_steps),
+        "sampling_fused_greedy_parity": out_g == out_gf,
+        "sampling_fused_deterministic": out_dev == out_dev2,
+        "sampling_steady_decode_uploads": int(steady_uploads),
+    }
+
+
 def run_bench(args: argparse.Namespace) -> dict:
     import jax
 
@@ -667,6 +779,7 @@ def run_bench(args: argparse.Namespace) -> dict:
     spec = _spec_ab(config, params, args)
     tp_ab = _tp_ab(config, params, args)
     quant = _quant_ab(config, params, args)
+    samp = _sampling_ab(config, params, args)
 
     record = {
         "bench": "paged_decode",
@@ -682,6 +795,7 @@ def run_bench(args: argparse.Namespace) -> dict:
         **spec,
         **tp_ab,
         **quant,
+        **samp,
     }
     failures = []
     for c in cases:
@@ -719,6 +833,19 @@ def run_bench(args: argparse.Namespace) -> dict:
         failures.append(
             "int8 capacity ratio below 1.9x at kv_limit "
             + ",".join(str(c["kv_limit"]) for c in bad_ratio)
+        )
+    if not samp["sampling_fused_greedy_parity"]:
+        failures.append(
+            "fused-sampling greedy outputs diverge from the host greedy "
+            "engine (sentinel-params argmax contract broken)"
+        )
+    if not samp["sampling_fused_deterministic"]:
+        failures.append("fused sampled outputs are not seed-deterministic")
+    if samp["sampling_steady_decode_uploads"] != 0:
+        failures.append(
+            "fused sampled decode paid "
+            f"{samp['sampling_steady_decode_uploads']} steady-state "
+            "h2d upload(s) (zero-upload contract broken)"
         )
     if failures:
         record["gate_failure"] = "; ".join(failures)

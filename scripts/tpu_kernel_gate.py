@@ -144,7 +144,7 @@ def _paged_case(name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed, t=1):
 
 def _quant_paged_case(
     name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed, t=1,
-    kv_dtype="int8",
+    kv_dtype="int8", quant_mxu=False,
 ):
     """Quantized paged decode: kernel-side dequant (scales DMAd with the
     block) vs the gather reference dequantizing OUTSIDE the kernel.
@@ -211,7 +211,7 @@ def _quant_paged_case(
         lambda q, kp, vp, ksc, vsc: paged_flash_decode(
             q, kp, vp, tables, positions,
             kv_limit=kv_limit, num_splits=num_splits,
-            k_scale=ksc, v_scale=vsc,
+            k_scale=ksc, v_scale=vsc, quant_mxu=quant_mxu,
         )
     )(q, kp, vp, ksc, vsc)
     o_r = jax.jit(ref)(q, kp, vp, ksc, vsc)
@@ -221,6 +221,71 @@ def _quant_paged_case(
     rel = float(np.abs(o_k - o_r).max()) / denom
     ok = rel < 5e-2  # quantized pool: dequant arithmetic differs in width
     print(f"[{'ok' if ok else 'FAIL'}] {name}: rel_fwd={rel:.2e}")
+    return ok
+
+
+def _sampled_decode_case(name, b, v, t, seed):
+    """Fused on-device sampling parity: jitted ``sample_lanes`` over
+    (B, V) decode (t=1) or (B, T, V) verify logits vs the host
+    ``sample`` path called row by row with the identically folded key.
+    Rows mix the greedy sentinel, plain temperature, top-k, top-p and the
+    combined filter — every row must match the host draw EXACTLY (same
+    fold_in key, same fp32 filter arithmetic), which is the device-side
+    half of the engine's greedy-token-identity contract."""
+    from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+        GREEDY_TEMPERATURE,
+        SamplingConfig,
+        sample,
+        sample_lanes,
+    )
+
+    ks = jax.random.split(jax.random.key(seed), 2)
+    shape = (b, v) if t == 1 else (b, t, v)
+    logits = jax.random.normal(ks[0], shape, jnp.float32) * 3.0
+    rng_data = jax.random.key_data(
+        jax.random.split(ks[1], b)
+    ).astype(jnp.uint32)
+    rng = np.random.default_rng(seed)
+    positions = jnp.asarray(rng.integers(0, 512, size=(b,)), jnp.int32)
+    index = positions if t == 1 else positions[:, None] + jnp.arange(t)
+    # per-lane params cycle through the sampling modes
+    modes = [
+        (GREEDY_TEMPERATURE, 0, 1.0),   # greedy sentinel -> exact argmax
+        (0.7, 0, 1.0),                  # temperature only
+        (1.3, 8, 1.0),                  # top-k
+        (0.9, 0, 0.8),                  # top-p
+        (1.1, 16, 0.9),                 # combined
+    ]
+    rows = [modes[i % len(modes)] for i in range(b)]
+    temps = jnp.asarray([r[0] for r in rows], jnp.float32)
+    topks = jnp.asarray([r[1] for r in rows], jnp.int32)
+    topps = jnp.asarray([r[2] for r in rows], jnp.float32)
+
+    got = np.asarray(jax.jit(sample_lanes)(
+        logits, rng_data, index, temps, topks, topps
+    ))
+    want = np.zeros(shape[:-1], np.int32)
+    lrows = np.asarray(logits).reshape(b, t if t > 1 else 1, v)
+    idx = np.asarray(jnp.broadcast_to(index, got.shape)).reshape(b, -1)
+    for i in range(b):
+        temp, tk, tp = rows[i]
+        base = jax.random.wrap_key_data(rng_data[i])
+        for j in range(lrows.shape[1]):
+            key = jax.random.fold_in(base, int(idx[i, j]))
+            if temp <= 0:
+                tok = int(np.argmax(lrows[i, j]))
+            else:
+                cfg = SamplingConfig(
+                    greedy=False, temperature=temp, top_k=tk, top_p=tp
+                )
+                tok = int(sample(jnp.asarray(lrows[i, j]), key, cfg))
+            if t == 1:
+                want[i] = tok
+            else:
+                want[i, j] = tok
+    ok = bool(np.array_equal(got, want))
+    print(f"[{'ok' if ok else 'FAIL'}] {name}: "
+          f"exact={'yes' if ok else 'NO'} rows={b} t={t}")
     return ok
 
 
@@ -338,6 +403,27 @@ def main() -> int:
     ]
     for c in quant_cases:
         ok &= _quant_paged_case(*c[:11], t=c[11], kv_dtype=c[12])
+    # MXU-native low-precision dot (PagedConfig.quant_mxu): the q·k dot
+    # stays int8 (int32 accumulate) / fp8, scales applied to the fp32
+    # score matrix — same dequant-outside reference, same 5% band
+    mxu_cases = [
+        ("quant-mxu-paged-int8-t1", 4, 8, 2, 64, 33, 16, 8, 128, 4, 40, 1, "int8"),
+        ("quant-mxu-paged-int8-t4", 3, 8, 2, 64, 33, 16, 8, 100, 2, 41, 4, "int8"),
+        ("quant-mxu-paged-fp8e4m3-t1", 4, 8, 2, 64, 33, 16, 8, 128, 4, 42, 1, "fp8_e4m3"),
+        ("quant-mxu-paged-fp8e5m2-t4", 3, 8, 2, 64, 33, 16, 8, 100, 2, 43, 4, "fp8_e5m2"),
+    ]
+    for c in mxu_cases:
+        ok &= _quant_paged_case(
+            *c[:11], t=c[11], kv_dtype=c[12], quant_mxu=True
+        )
+    # fused on-device sampling (PagedConfig.on_device_sampling): exact
+    # host-draw parity for decode- and verify-shaped logits
+    sampled_cases = [
+        ("sampled-decode-t1", 5, 256, 1, 50),
+        ("sampled-decode-t4", 5, 256, 4, 51),
+    ]
+    for c in sampled_cases:
+        ok &= _sampled_decode_case(*c)
     # tp=2 head-sharded shard_map wrapping of the same kernel (serving's
     # multi-chip layout); nkv/n both divide tp in every case by design
     #                 name                  b  n  nkv d   nb  bs  w  L    spl sd  t

@@ -228,6 +228,8 @@ EXPECTED_SNAPSHOT_KEYS = {
     "drafter_faults", "degradation_level", "degradations",
     "audit_violations", "programs_compiled", "prewarm_compiles",
     "steadystate_compiles",
+    # fused on-device sampling
+    "sampled_steps", "host_sample_fallbacks", "rng_reseeds",
     # graftmeter: pad-waste / dispatch-cost counters + cost-ledger gauges
     "decode_pad_tokens", "decode_need_tokens", "prefill_pad_tokens",
     "prefill_need_tokens", "dispatched_flops", "dispatched_bytes",
