@@ -48,6 +48,17 @@ GC008  steady-state compile freeze: after prewarm/first traffic marks
        set or re-lowering an existing key at different avals is flagged
        (the static twin of a recompile stall). Ladder-driven gather
        twins on a degraded engine are exempt.
+GC009  cost-accounting completeness: a metered engine may not hold a
+       program key without a usable device-cost profile
+       (serving/accounting.py; checked by :func:`audit_programs`).
+GC010  schedule legality: an engine's recorded step-action trace must
+       be accepted by the legality automaton in
+       :mod:`.graftsched` (verify only after the lookahead drains,
+       full-lane syncs and block release only at pipeline-drained
+       boundaries, readback lag <= 1, no dispatch into a freed lane).
+       The replay entry point is ``graftsched.check_action_trace``;
+       it lives in the GC catalogue because it audits *recorded
+       engine behavior* at teardown, exactly like audit_programs.
 
 Suppression: jaxprs have no source lines to annotate, so suppression is
 per (program, rule) — pass ``suppress={"GC003", ...}`` to the check
@@ -107,6 +118,10 @@ GC_RULES: Dict[str, str] = {
     "GC007": "program key not derivable from the declared catalog manifest",
     "GC008": "registry grew or a key re-lowered after the steady-state freeze",
     "GC009": "cost-accounting engine holds a key without a usable CostProfile",
+    "GC010": (
+        "recorded step-action trace rejected by the schedule legality "
+        "automaton (analysis/graftsched.py)"
+    ),
 }
 
 #: default axis universe for GC004 — kept in sync with parallel/state.py

@@ -27,6 +27,16 @@ SL005  no raw ``with_sharding_constraint`` inside ``shard_map`` bodies
        annotations); use ``parallel.layers.constrain``.
 SL006  ``lax.axis_index``/``axis_size`` axes must be bound by the
        enclosing ``shard_map``'s explicit ``axis_names``.
+SL007  donated ``jax.jit`` calls in ``serving/`` must go through the
+       engine's ``_register_program`` registry (anything else is a
+       compiled buffer-stealing program graftcheck can never audit).
+SL008  the serving engine's device-resident decode arrays
+       (``_d_tokens`` …) and their host mirrors (``_tokens`` …) are
+       written only inside the blessed funnel methods
+       (``RESIDENT_WRITERS`` / ``MIRROR_WRITERS``); any other write is
+       a host-state race candidate — it can land between a dispatch
+       and its readback or skip the dirty-bit flush discipline. The
+       static twin of graftsched's GC010 schedule automaton.
 
 Suppression: append ``# shardlint: disable=SL00x[,SL00y]`` to the
 flagged line, or put ``# shardlint: skip-file`` anywhere in the file.
@@ -66,7 +76,40 @@ RULES: Dict[str, str] = {
     "SL005": "raw with_sharding_constraint inside a shard_map body",
     "SL006": "axis_index/axis_size axis not bound by enclosing shard_map",
     "SL007": "ad-hoc donated jax.jit in serving/ outside _register_program",
+    "SL008": (
+        "write to an engine resident array or host mirror outside the "
+        "blessed funnels"
+    ),
 }
+
+# --- SL008: the serving engine's device-resident decode state and its
+# host mirrors are written only through a small set of blessed funnels;
+# any other write is a host-state race candidate (it can land between a
+# dispatch and its readback, or skip the dirty-bit flush discipline).
+# Kept in sync with serving/engine.py — the graftsched automaton checks
+# the *dynamic* ordering of these writes, SL008 pins the static surface.
+RESIDENT_ARRAYS = frozenset({
+    "_d_tokens", "_d_positions", "_d_tables",
+    "_d_temps", "_d_topks", "_d_topps", "_d_rng",
+})
+HOST_MIRRORS = frozenset({
+    "_tokens", "_positions", "_tables",
+    "_temps", "_topks", "_topps", "_rng",
+})
+#: methods allowed to rebind/overwrite device residents (dispatch funnels
+#: swap the donated outputs back in; flush/prewarm re-upload).
+RESIDENT_WRITERS = frozenset({
+    "__init__", "prewarm", "_flush_state",
+    "_step_async", "_dispatch_sync_decode", "_verify_phase",
+})
+#: methods allowed to write host mirror rows (all of them either mark the
+#: lane dirty for _flush_state or are the post-readback commit itself).
+MIRROR_WRITERS = frozenset({
+    "__init__", "_admit_wave", "_advance_prefills", "_append_block",
+    "_read_and_apply", "_release_lane", "_dispatch_sync_decode",
+    "_step_async", "_verify_phase",
+    "_install_lane_sampling", "_clear_lane_sampling",
+})
 
 # functions whose result depends on the live parallel layout: calling one
 # from an eq-keyed dataclass method makes the trace layout-dependent while
@@ -801,6 +844,73 @@ def _rule_sl007(ctx: _ModuleContext) -> List[Finding]:
     return out
 
 
+def _rule_sl008(ctx: _ModuleContext) -> List[Finding]:
+    """Writes to the engine's device-resident decode arrays or their host
+    mirrors outside the blessed funnels. Every legal write either marks
+    the lane dirty for ``_flush_state`` (mirrors) or swaps a dispatched
+    program's donated output back in (residents); a write anywhere else
+    can land between a dispatch and its readback — exactly the host-state
+    race class graftsched's automaton (GC010) catches dynamically, pinned
+    here at the source level so it never ships at all."""
+    norm = ctx.path.replace(os.sep, "/")
+    if "/serving/" not in norm and not norm.startswith("serving/"):
+        return []
+    out: List[Finding] = []
+
+    def _protected_attr(t: ast.AST) -> Optional[str]:
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if (
+            isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+        ):
+            return t.attr
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        else:
+            continue
+        flat: List[ast.AST] = []
+        for t in targets:
+            flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t])
+        for t in flat:
+            attr = _protected_attr(t)
+            if attr in RESIDENT_ARRAYS:
+                kind, allowed = "resident array", RESIDENT_WRITERS
+            elif attr in HOST_MIRRORS:
+                kind, allowed = "host mirror", MIRROR_WRITERS
+            else:
+                continue
+            fn = ctx._parents.get(node)
+            while fn is not None and not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                fn = ctx._parents.get(fn)
+            if fn is not None and fn.name in allowed:
+                continue
+            where = fn.name if fn is not None else "<module>"
+            f = _finding(
+                ctx,
+                "SL008",
+                node,
+                f"write to engine {kind} self.{attr} in {where}() — "
+                "outside the blessed funnels",
+                "route the write through a blessed funnel "
+                "(_release_lane/_install_lane_sampling/... for mirrors, "
+                "the dispatch/flush funnels for residents) or, for a new "
+                "funnel, add it to shardlint's RESIDENT_WRITERS/"
+                "MIRROR_WRITERS with review",
+            )
+            if f:
+                out.append(f)
+    return out
+
+
 _RULE_FNS = (
     _rule_sl001,
     _rule_sl002,
@@ -809,6 +919,7 @@ _RULE_FNS = (
     _rule_sl005,
     _rule_sl006,
     _rule_sl007,
+    _rule_sl008,
 )
 
 

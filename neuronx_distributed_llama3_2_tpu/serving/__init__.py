@@ -51,6 +51,16 @@ from neuronx_distributed_llama3_2_tpu.serving.invariants import (
     summarize_violations,
 )
 from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
+from neuronx_distributed_llama3_2_tpu.serving.policy import (
+    ActionType,
+    EngineView,
+    FifoPolicy,
+    POLICIES,
+    StepAction,
+    StepPolicy,
+    make_policy,
+    register_policy,
+)
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
     RadixPrefixIndex,
 )
@@ -66,6 +76,14 @@ from neuronx_distributed_llama3_2_tpu.serving.tracing import (
 __all__ = [
     "FAULT_KINDS",
     "NULL_BLOCK",
+    "POLICIES",
+    "ActionType",
+    "EngineView",
+    "FifoPolicy",
+    "StepAction",
+    "StepPolicy",
+    "make_policy",
+    "register_policy",
     "AllocatorError",
     "BlockAllocator",
     "BucketLadder",

@@ -71,6 +71,14 @@ from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     BlockAllocator,
 )
 from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
+from neuronx_distributed_llama3_2_tpu.serving.policy import (
+    ActionType,
+    EngineView,
+    POLICY_ACTIONS,
+    StepAction,
+    StepPolicy,
+    make_policy,
+)
 from neuronx_distributed_llama3_2_tpu.serving.slo import SLOMonitor, SLOPolicy
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
     RadixPrefixIndex,
@@ -287,6 +295,14 @@ class PagedConfig:
     # sustained burn feeds the PR 8 degradation ladder through the same
     # _note_event funnel chaos faults use (ladder knobs must also be on)
     slo_degrade: bool = False
+    # -- step scheduling (docs/serving.md "Step policy"; serving/policy.py) --
+    # name of the registered StepPolicy choosing each step's action
+    # schedule. "fifo" is the historical inlined phase order,
+    # byte-for-byte. A policy *instance* can also be passed to the engine
+    # constructor (policy=), e.g. for the graftsched explorer's permuted
+    # schedules; the config knob stays a name so PagedConfig remains
+    # hashable/frozen.
+    step_policy: str = "fifo"
 
 
 @dataclasses.dataclass
@@ -344,6 +360,7 @@ class PagedServingEngine:
         precompile: bool = True,
         drafter: Optional[Any] = None,
         injector: Optional[FaultInjector] = None,
+        policy: Optional[StepPolicy] = None,
     ) -> None:
         self.engine = engine
         self.model = engine.model
@@ -386,9 +403,26 @@ class PagedServingEngine:
             self.drafter = NGramDrafter(
                 max_n=paged.spec_ngram_max, min_n=paged.spec_ngram_min
             )
-        # steps left before the next draft attempt while the async
-        # lookahead owns the loop (PagedConfig.spec_retry_steps)
-        self._spec_pause = 0
+        # step scheduling policy (serving/policy.py): each step() asks it
+        # for the action schedule; the drafting-pause counter that used to
+        # live here is FifoPolicy state now (it IS a scheduling decision)
+        self.policy = policy if policy is not None else make_policy(
+            paged.step_policy
+        )
+        self.policy.reset()
+        self._view = EngineView(self)
+        # outcome flags the policy generator reads after an action executes
+        self._last_verify_drafted = False
+        self._last_async_fell_back = False
+        # graftsched action trace: per-step (step_index, pending_at_start,
+        # [StepAction...]) records, ring-bounded like the flight recorder;
+        # analysis/graftsched.py replays it against the legality automaton
+        # (GC010). _on_action is the explorer's per-transition audit hook.
+        self.action_trace: deque = deque(
+            maxlen=paged.trace_buffer_steps or 256
+        )
+        self._step_actions: List[StepAction] = []  # pre-step emissions: untraced
+        self._on_action = None
         # declared bucket ladders (serving/catalog.py): every dispatch
         # shape pads into one of these rungs, so the compiled-program set
         # is O(ladder) however heterogeneous traffic gets. Suffix prefill
@@ -1105,10 +1139,19 @@ class PagedServingEngine:
         committed replicated one are *different lowerings* to jit, so a
         resident constructed without this pays one re-lower per program
         on its second dispatch (the recompile class GC008 exists to
-        catch). No-op off-mesh."""
+        catch).
+
+        Always copies, even off-mesh: on CPU backends ``jnp.asarray`` of
+        a numpy array can ZERO-COPY alias the host buffer, and the first
+        donated dispatch then writes its output straight through the
+        alias into the engine's host mirror — nondeterministic
+        frontier-lag corruption, caught by graftsched's per-action
+        explorer audits. The copy severs the alias so donation can only
+        ever recycle device-owned storage."""
+        pinned = jnp.array(x, copy=True)
         if self._replicated_sharding is None:
-            return x
-        return jax.device_put(x, self._replicated_sharding)
+            return pinned
+        return jax.device_put(pinned, self._replicated_sharding)
 
     def _upload(self, x, dtype=jnp.int32):
         """Every host→device transfer on the serving path funnels through
@@ -1132,6 +1175,19 @@ class PagedServingEngine:
         if self.tracer.enabled:
             self.tracer.complete("readback", t0, t1, n=int(arr.size))
         return arr
+
+    def _emit_action(self, atype: ActionType, mode: str = "", **meta) -> None:
+        """Record one executed step-action into the graftsched action
+        trace (host-only, bounded by the per-step ring). Policy-yielded
+        actions are recorded by their executors; engine-internal
+        transitions (PREEMPT/FINISH/flushes) funnel through here from the
+        methods that perform them, so the trace is a faithful schedule of
+        what actually ran — not of what the policy asked for."""
+        rec = StepAction(atype, mode, meta)
+        self._step_actions.append(rec)
+        cb = self._on_action
+        if cb is not None:
+            cb(self, rec)
 
     # -- fused-sampling lane state (PagedConfig.on_device_sampling) --------
 
@@ -1238,6 +1294,27 @@ class PagedServingEngine:
         m[poison] = 1
         return self._upload(m)
 
+    def _release_lane(self, req: _PagedRequest) -> None:
+        """THE lane-teardown funnel (finish / fail / preempt): release the
+        request's blocks and null the lane's host mirrors, marking the
+        lane dirty for the next full-lane sync. Only legal with no
+        lookahead in flight — the callers drain first. One of the blessed
+        host-mirror writers shardlint SL008 admits; teardown mirror writes
+        anywhere else are findings."""
+        lane = req.lane
+        for b in req.table:
+            self.allocator.release(b)
+        req.table = []
+        req.table_dev = None
+        del self._active[lane]
+        self._free_lanes.append(lane)
+        self._tables[lane, :] = NULL_BLOCK
+        self._tokens[lane] = 0
+        self._positions[lane] = 0
+        self._clear_lane_sampling(lane)
+        self._dirty_lanes.add(lane)
+        req.lane = None
+
     def _fail_request(self, req: _PagedRequest, error: str) -> None:
         """Terminal failure — the per-request failure domain. Mirrors
         ``_preempt``'s teardown (blocks released, lane freed, mirrors
@@ -1257,19 +1334,11 @@ class PagedServingEngine:
             self._queue.remove(req)
         if req.lane is not None:
             lane = req.lane
-            for b in req.table:
-                self.allocator.release(b)
-            req.table = []
-            req.table_dev = None
             req.prefilling = False
-            del self._active[lane]
-            self._free_lanes.append(lane)
-            self._tables[lane, :] = NULL_BLOCK
-            self._tokens[lane] = 0
-            self._positions[lane] = 0
-            self._clear_lane_sampling(lane)
-            self._dirty_lanes.add(lane)
-            req.lane = None
+            self._release_lane(req)
+            self._emit_action(
+                ActionType.FINISH, rid=req.rid, lane=lane, failed=True,
+            )
         self._finished[req.rid] = req
         self.metrics.failed_requests += 1
         self._note_terminal(req)
@@ -1436,6 +1505,9 @@ class PagedServingEngine:
         )
 
         violations = audit_engine(self)
+        self._emit_action(
+            ActionType.AUDIT, strict=strict, violations=len(violations),
+        )
         if violations:
             self.metrics.audit_violations += len(violations)
             logger.error("serving invariant violations: %s", violations)
@@ -1680,17 +1752,28 @@ class PagedServingEngine:
         wave runs inline)."""
         if not (self._queue and self._free_lanes):
             return
+        lanes_before = set(self._active)
         tr = self.tracer
-        if not tr.enabled:
-            return self._admit_wave()
-        before = self.metrics.admitted
-        t0 = tr.now()
         try:
-            self._admit_wave()
+            if not tr.enabled:
+                self._admit_wave()
+            else:
+                before = self.metrics.admitted
+                t0 = tr.now()
+                try:
+                    self._admit_wave()
+                finally:
+                    tr.complete(
+                        "admit", t0, waiting=len(self._queue),
+                        admitted=self.metrics.admitted - before,
+                    )
         finally:
-            tr.complete(
-                "admit", t0, waiting=len(self._queue),
-                admitted=self.metrics.admitted - before,
+            # a lane admitted-and-finished inside the wave is absent here;
+            # its FINISH record (already emitted) carries the lane id
+            self._emit_action(
+                ActionType.ADMIT,
+                lanes=sorted(set(self._active) - lanes_before),
+                waiting=len(self._queue),
             )
 
     def _admit_wave(self) -> None:
@@ -1913,6 +1996,10 @@ class PagedServingEngine:
             req.prefill_pos = start + len(piece)
             self.metrics.prefill_tokens += len(piece)
             self.metrics.prefill_chunks += 1
+            self._emit_action(
+                ActionType.PREFILL_CHUNK, rid=req.rid, lane=lane,
+                tokens=len(piece), final=final,
+            )
             if not final:
                 continue
             # final chunk: sample the first token, install the real table
@@ -1941,27 +2028,19 @@ class PagedServingEngine:
         the ladder's own top-rung load shedding (``shed=True``) does not —
         deliberate shedding must not retrigger the ladder."""
         lane = req.lane
-        for b in req.table:
-            self.allocator.release(b)
-        req.table = []
-        req.lane = None
+        self._release_lane(req)
         req.position = 0
         # a victim caught mid-chunked-prefill restarts its prefill from the
         # (possibly re-matched) cached prefix on re-admission
         req.prefilling = False
         req.prefill_pos = 0
         req.prefill_target = 0
-        req.table_dev = None
-        del self._active[lane]
-        self._free_lanes.append(lane)
-        self._tables[lane, :] = NULL_BLOCK
-        self._tokens[lane] = 0
-        self._positions[lane] = 0
-        self._clear_lane_sampling(lane)
-        self._dirty_lanes.add(lane)
         self._queue.insert(0, req)
         req.preemptions += 1
         self.metrics.preemptions += 1
+        self._emit_action(
+            ActionType.PREEMPT, rid=req.rid, lane=lane, shed=shed,
+        )
         self.tracer.instant("preempt", rid=req.rid, shed=shed)
         self.tracer.request_state(req.rid, "preempted")
         if not shed:
@@ -2044,20 +2123,12 @@ class PagedServingEngine:
             # written, so it is excluded
             seq = (req.prompt + req.out)[: req.position]
             self.index.insert(seq, req.table[: _ceil_div(req.position, bs)])
+        lane = req.lane
         if req.lane is not None:
-            lane = req.lane
-            for b in req.table:
-                self.allocator.release(b)
-            req.table = []
-            req.table_dev = None
-            del self._active[lane]
-            self._free_lanes.append(lane)
-            self._tables[lane, :] = NULL_BLOCK
-            self._tokens[lane] = 0
-            self._positions[lane] = 0
-            self._clear_lane_sampling(lane)
-            self._dirty_lanes.add(lane)
-            req.lane = None
+            self._release_lane(req)
+        self._emit_action(
+            ActionType.FINISH, rid=req.rid, lane=lane, failed=False,
+        )
         self._finished[req.rid] = req
         self.metrics.finished += 1
         self._note_terminal(req)
@@ -2075,6 +2146,11 @@ class PagedServingEngine:
         run with no step pending (dirty lanes are only ever marked by
         scheduler events, which drain the pipeline first)."""
         if self._table_delta_list:
+            self._emit_action(
+                ActionType.TABLE_DELTA_FLUSH,
+                n=len(self._table_delta_list),
+                in_flight=self._pending is not None,
+            )
             with self.tracer.phase(
                 "table_delta_flush", n=len(self._table_delta_list)
             ):
@@ -2090,6 +2166,11 @@ class PagedServingEngine:
                 self._table_delta_list.clear()
         if self._dirty_lanes:
             assert self._pending is None, "full-lane sync with step in flight"
+            self._emit_action(
+                ActionType.LANE_SET_FLUSH,
+                lanes=sorted(self._dirty_lanes),
+                in_flight=self._pending is not None,
+            )
             with self.tracer.phase(
                 "lane_sync_flush", lanes=sorted(self._dirty_lanes)
             ):
@@ -2159,6 +2240,13 @@ class PagedServingEngine:
                 req.done = True
             if self._finish_due(req):
                 finishing.append(req)
+        # emitted AFTER the commit loop: at emission the host request state
+        # is consistent again, so the explorer's per-action audit hook sees
+        # no transient frontier lag
+        self._emit_action(
+            ActionType.READBACK, lanes=list(lanes),
+            lag=self._last_readback_lag,
+        )
         if (finishing or quarantined) and self._pending is not None:
             # Lame-duck drain: the lookahead step already ran with the
             # finished (or quarantined) lanes still in the batch.
@@ -2171,6 +2259,11 @@ class PagedServingEngine:
             for lane in lanes2:
                 if lane in dead:
                     self.metrics.lame_duck_tokens += 1
+                    # the discarded dispatch advanced the frontier mirror;
+                    # retreat it so host state is self-consistent at the
+                    # READBACK emission below (the lane is released right
+                    # after, but per-action audits run in between)
+                    self._positions[lane] -= 1
                     continue  # discard the post-finish/post-poison token
                 req = self._active[lane]
                 if fin2 is not None and not bool(fin2[lane]):
@@ -2183,6 +2276,10 @@ class PagedServingEngine:
                     req.done = True
                 if self._finish_due(req):
                     finishing.append(req)
+            self._emit_action(
+                ActionType.READBACK, lanes=list(lanes2),
+                lag=self._last_readback_lag, lame_duck=True,
+            )
         for req in finishing:
             self._maybe_finish(req)
         for req in quarantined:
@@ -2254,6 +2351,10 @@ class PagedServingEngine:
             )
         self._d_tokens = toks
         self._dispatch_count += 1
+        self._emit_action(
+            ActionType.DECODE_DISPATCH, mode="async",
+            lanes=list(decode_lanes), kv=kv_limit,
+        )
         prev, self._pending = self._pending, (
             toks, decode_lanes, self._dispatch_count, finite,
         )
@@ -2264,16 +2365,6 @@ class PagedServingEngine:
         if prev is not None:
             self._read_and_apply(prev)
         return bool(self._active or self._queue)
-
-    def _step_sync(self) -> bool:
-        """The synchronous loop: admission, chunked-prefill advance, then
-        one decode step dispatched and read back within the same call.
-        Still device-resident — dispatch consumes the resident arrays after
-        flushing queued lane updates, so the only per-step host traffic is
-        the token readback."""
-        self._admit()
-        self._advance_prefills()
-        return self._dispatch_sync_decode()
 
     def _dispatch_sync_decode(self) -> bool:
         """The decode tail of a synchronous step (shared with the
@@ -2329,6 +2420,10 @@ class PagedServingEngine:
             )
         self._d_tokens = toks
         self._dispatch_count += 1
+        self._emit_action(
+            ActionType.DECODE_DISPATCH, mode="sync",
+            lanes=list(decode_lanes), kv=kv_limit,
+        )
         for lane in decode_lanes:
             self._positions[lane] += 1
         self.metrics.decode_steps += 1
@@ -2397,19 +2492,18 @@ class PagedServingEngine:
                 else:
                     proposals[lane] = proposals[lane][:backed]
 
-    def _step_spec(self) -> tuple:
-        """One synchronous step whose decode dispatch is a multi-token
-        verify (``LlamaDecode.verify_step``): every decode lane rides the
-        same T = k+1 program — drafting lanes advance by their on-device
-        accept length + 1, lanes whose drafter abstained carry
-        ``draft_len 0`` and take what is exactly a plain greedy decode
-        step. Verify needs same-step readback (the accept length decides
-        how far each lane's host state advances), so this path never
-        overlaps the async lookahead — the pipeline is drained before
-        entry. Returns ``(alive, drafted)``; with no proposals at all the
-        step falls through to the plain sync decode."""
-        self._admit()
-        self._advance_prefills()
+    def _verify_phase(self) -> bool:
+        """The VERIFY action body: one multi-token verify dispatch
+        (``LlamaDecode.verify_step``) for every decode lane — drafting
+        lanes advance by their on-device accept length + 1, lanes whose
+        drafter abstained carry ``draft_len 0`` and take what is exactly a
+        plain greedy decode step. Verify needs same-step readback (the
+        accept length decides how far each lane's host state advances), so
+        the legality automaton requires the lookahead drained before this
+        action. Returns ``drafted``: False means nothing was dispatched
+        (the drafter abstained everywhere or backing preempted every
+        drafting lane) and the policy is expected to schedule a plain
+        decode instead."""
         proposals = self._collect_drafts()
         if proposals:
             self._prepare_spec_blocks(proposals)
@@ -2423,7 +2517,7 @@ class PagedServingEngine:
                 and not self._active[l].prefilling
             }
         if not proposals:
-            return self._dispatch_sync_decode(), False
+            return False
         decode_lanes = [
             l for l, r in self._active.items() if not r.prefilling
         ]
@@ -2476,6 +2570,10 @@ class PagedServingEngine:
             )
         self._d_tokens = new_tokens
         self._dispatch_count += 1
+        self._emit_action(
+            ActionType.VERIFY, lanes=list(decode_lanes), k=k,
+            drafts=int(draft_len.sum()), kv=kv_limit,
+        )
         self.metrics.decode_steps += 1
         self.metrics.verify_steps += 1
         self.metrics.draft_tokens += int(draft_len.sum())
@@ -2524,43 +2622,84 @@ class PagedServingEngine:
             self._maybe_finish(req)
         for req in quarantined:
             self._quarantine(req, "verify")
-        return bool(self._active or self._queue), True
+        return True
+
+    # backstop against a runaway policy generator (the explorer drives
+    # arbitrary third-party schedules through this loop)
+    _MAX_ACTIONS_PER_STEP = 64
+
+    def _execute_action(self, act: StepAction) -> None:
+        """Run one policy-scheduled action. Engine-internal transitions
+        (PREEMPT/FINISH/flushes) are consequences of these, never directly
+        schedulable — a policy yielding one is a programming error."""
+        t = act.type
+        if t is ActionType.READBACK:
+            self._drain_pending()
+        elif t is ActionType.ADMIT:
+            self._admit()
+        elif t is ActionType.PREFILL_CHUNK:
+            self._advance_prefills()
+        elif t is ActionType.VERIFY:
+            self._last_verify_drafted = self._verify_phase()
+        elif t is ActionType.DECODE_DISPATCH:
+            if act.mode == "async":
+                if self._ensure_decode_blocks_async():
+                    self._last_async_fell_back = False
+                    self._step_async()
+                else:
+                    # Pool dry: the scheduler must preempt, which mutates
+                    # lane state — the policy reads this outcome and drops
+                    # to the synchronous sequence for this step.
+                    self._last_async_fell_back = True
+                    self.metrics.sync_fallbacks += 1
+            else:
+                self._dispatch_sync_decode()
+        elif t is ActionType.AUDIT:
+            self._audit(strict=False)
+        else:
+            raise ValueError(
+                f"policy scheduled engine-internal action {t.value}; "
+                f"schedulable actions: "
+                f"{sorted(a.value for a in POLICY_ACTIONS)}"
+            )
 
     def _step_inner(self) -> bool:
-        # degradation ladder: rung 1 sheds speculation, rung 2 the async
-        # lookahead (rung 3 — the paged kernel — is applied at program
-        # selection, rung 4 at _update_ladder)
-        spec_on = self._spec_k and self._degrade_level < 1
-        async_on = self.paged.async_loop and self._degrade_level < 2
-        if spec_on and self._spec_pause <= 0:
-            self._drain_pending()
-            alive, drafted = self._step_spec()
-            # a dry drafter hands the loop to the async lookahead for a few
-            # steps (spec_retry_steps) instead of pinning it to sync mode;
-            # with async off there is nothing to yield to — retry every step
-            if not drafted and async_on:
-                self._spec_pause = self.paged.spec_retry_steps
-            return alive
-        if self._spec_pause > 0:
-            self._spec_pause -= 1
-        if async_on and self._async_eligible():
-            if self._ensure_decode_blocks_async():
-                return self._step_async()
-            # Pool dry: the scheduler must preempt, which mutates lane
-            # state — drop to the synchronous loop for this step.
-            self.metrics.sync_fallbacks += 1
-        self._drain_pending()
-        return self._step_sync()
+        # the step schedule comes from the policy (serving/policy.py):
+        # each yielded action executes before the generator resumes, so
+        # the policy reads post-action outcomes (view.last_*) to decide
+        # data-dependent fallbacks. The degradation ladder's rung 1/2
+        # shedding is a policy decision too (FifoPolicy reads
+        # view.degrade_level); rung 3 — the paged kernel — is applied at
+        # program selection, rung 4 at _update_ladder.
+        n = 0
+        for act in self.policy.actions(self._view):
+            n += 1
+            if n > self._MAX_ACTIONS_PER_STEP:
+                raise RuntimeError(
+                    f"step policy {self.policy.name!r} exceeded "
+                    f"{self._MAX_ACTIONS_PER_STEP} actions in one step"
+                )
+            self._execute_action(act)
+        return bool(self._active or self._queue)
 
     def step(self) -> bool:
-        """Admit waiting requests, push one prefill chunk per prefilling
+        """Execute one step *schedule*: the configured :class:`StepPolicy`
+        (serving/policy.py) yields a sequence of typed actions over the
+        alphabet {ADMIT, PREFILL_CHUNK, DECODE_DISPATCH, READBACK, VERIFY,
+        AUDIT} and the engine runs them in order, recording every executed
+        action — plus the engine-internal PREEMPT / FINISH /
+        LANE_SET_FLUSH / TABLE_DELTA_FLUSH transitions — into the bounded
+        ``action_trace`` that analysis/graftsched.py replays against the
+        schedule legality automaton (GC010). The default FifoPolicy order:
+        admit waiting requests, push one prefill chunk per prefilling
         lane, then advance every decode-ready lane one token — so a long
-        prompt's chunks interleave with the existing streams' decode steps.
-        Pool exhaustion preempts-and-requeues instead of raising. With
-        ``PagedConfig.async_loop`` the steady-state decode path runs a
-        depth-1 lookahead pipeline (docs/serving.md "Async step pipeline");
-        note per-request state then trails the device by one step until the
-        pipeline drains. Returns False when nothing is left to do.
+        prompt's chunks interleave with the existing streams' decode
+        steps. Pool exhaustion preempts-and-requeues instead of raising.
+        With ``PagedConfig.async_loop`` the steady-state decode path runs
+        a depth-1 lookahead pipeline (docs/serving.md "Async step
+        pipeline"); per-request state then trails the device by one step
+        until the pipeline drains. Returns False when nothing is left to
+        do.
 
         Failure domains: an injected device fault aborts only its victim
         lanes (terminal ``failed`` status, blocks released, survivors
@@ -2571,6 +2710,13 @@ class PagedServingEngine:
         t0 = time.perf_counter()
         self._wait_ms = 0.0
         self._step_index += 1
+        # fresh per-step action record; everything _emit_action sees until
+        # the next step() — including _update_ladder preemptions and fault
+        # recovery below — lands in this step's trace entry
+        self._step_actions = []
+        self.action_trace.append(
+            (self._step_index, self._pending is not None, self._step_actions)
+        )
         self.tracer.begin_step(self._step_index)
         if self.injector is not None:
             self.injector.begin_step(self._step_index)

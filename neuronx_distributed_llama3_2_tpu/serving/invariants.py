@@ -37,11 +37,20 @@ Invariants checked:
    and maps back to its node; parent/child links are consistent.
 7. Scale-array presence — the cache carries k/v scale arrays iff
    ``PagedConfig.kv_cache_dtype`` is quantized.
+8. Fused-sampling residents — with ``PagedConfig.on_device_sampling``
+   the four sampling residents (temps/topks/topps/rng) are present and
+   the host mirrors correctly shaped; free lanes sit parked at the
+   greedy sentinel (temp <= 0, topk 0, topp 1, null key), active lanes
+   carry the installed GenerationConfig params and their request's
+   SeedSequence-derived base key (the preempt-resume replay contract).
+   Without the knob, all four residents are None.
 """
 
 from __future__ import annotations
 
 from typing import List
+
+import numpy as np
 
 from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     NULL_BLOCK,
@@ -174,4 +183,70 @@ def audit_engine(engine) -> List[str]:
             f"kv_cache_dtype={engine.paged.kv_cache_dtype!r} but cache "
             f"scale arrays present=(k={has_k}, v={has_v})"
         )
+
+    # 8. fused-sampling residents match the on_device_sampling knob
+    residents = {
+        "_d_temps": engine._d_temps, "_d_topks": engine._d_topks,
+        "_d_topps": engine._d_topps, "_d_rng": engine._d_rng,
+    }
+    if not engine._fused:
+        for name, arr in residents.items():
+            if arr is not None:
+                v.append(
+                    f"sampling resident {name} present without "
+                    "on_device_sampling"
+                )
+        return v
+    for name, arr in residents.items():
+        if arr is None:
+            v.append(f"on_device_sampling engine missing resident {name}")
+    mirror_spec = (
+        ("_temps", engine._temps, (max_batch,), np.float32),
+        ("_topks", engine._topks, (max_batch,), np.int32),
+        ("_topps", engine._topps, (max_batch,), np.float32),
+        ("_rng", engine._rng, (max_batch, 2), np.uint32),
+    )
+    for name, arr, shape, dtype in mirror_spec:
+        if arr.shape != shape or arr.dtype != dtype:
+            v.append(
+                f"sampling mirror {name}: shape {arr.shape}/{arr.dtype} != "
+                f"{shape}/{np.dtype(dtype)}"
+            )
+    for lane in free_lanes:
+        # released lanes park at the greedy sentinel with a null key
+        # (_clear_lane_sampling writes the mirror at release time, so this
+        # holds whether or not the lane_set flush has happened yet)
+        if (
+            engine._temps[lane] > 0.0
+            or engine._topks[lane] != 0
+            or engine._topps[lane] != 1.0
+            or engine._rng[lane].any()
+        ):
+            v.append(f"free lane {lane}: sampling mirror not parked")
+    s = engine.gen.sampling
+    for lane, req in engine._active.items():
+        if s.greedy:
+            ok = (
+                engine._temps[lane] <= 0.0
+                and engine._topks[lane] == 0
+                and engine._topps[lane] == 1.0
+            )
+        else:
+            ok = (
+                engine._temps[lane] == np.float32(s.temperature)
+                and engine._topks[lane] == s.top_k
+                and engine._topps[lane] == np.float32(s.top_p)
+            )
+        if not ok:
+            v.append(
+                f"rid {req.rid}: lane {lane} sampling params do not match "
+                "the GenerationConfig install"
+            )
+        if not s.greedy and not np.array_equal(
+            engine._rng[lane], engine._lane_rng(req.rid)
+        ):
+            v.append(
+                f"rid {req.rid}: lane {lane} rng key != the request's "
+                "SeedSequence base key (preempt-resume replay would diverge)"
+            )
     return v

@@ -1,11 +1,12 @@
 #!/usr/bin/env python
 """graftcheck CI gate: trace the serving engine's representative programs
-and enforce the GC001-GC009 program-level rules.
+and enforce the GC001-GC010 program-level rules.
 
 Usage:
     python scripts/graftcheck_gate.py                   # run the catalog
     python scripts/graftcheck_gate.py --list            # list catalog entries
     python scripts/graftcheck_gate.py --rules           # print the catalogue
+    python scripts/graftcheck_gate.py --list-rules      # alias of --rules
     python scripts/graftcheck_gate.py --write-baseline
     python scripts/graftcheck_gate.py --catalog-diff    # manifest vs registry
     python scripts/graftcheck_gate.py --write-catalog   # refresh the golden
@@ -308,6 +309,23 @@ def _catalog_drift(name, engine, catalog_path=DEFAULT_CATALOG):
     return findings
 
 
+def _sched_trace_findings(name, engine):
+    """The GC010 arm: replay the driven engine's recorded step-action
+    trace through graftsched's legality automaton (same teardown shape
+    as audit_programs), re-keyed into gate findings."""
+    from neuronx_distributed_llama3_2_tpu.analysis.graftsched import (
+        check_action_trace,
+    )
+
+    return [
+        Finding(
+            rule=f.rule, program=f"gate:{name}",
+            message=f"{f.where}: {f.message}", hint=f.hint, detail=f.detail,
+        )
+        for f in check_action_trace(engine)
+    ]
+
+
 def _cost_lines(engine):
     """Deterministic analytic cost-table lines for the engine's catalog
     prewarm keys (no compiles, no XLA figures — see --write-costs)."""
@@ -371,6 +389,7 @@ def entry_catalog():
     )
     return (
         audit_programs(engine)
+        + _sched_trace_findings("catalog-int8", engine)
         + _catalog_drift("catalog-int8", engine)
         + _costs_drift("catalog-int8", engine)
     )
@@ -396,6 +415,7 @@ def entry_catalog_tp2():
         )
         return (
             audit_programs(engine)
+            + _sched_trace_findings("catalog-tp2", engine)
             + _catalog_drift("catalog-tp2", engine)
             + _costs_drift("catalog-tp2", engine)
         )
@@ -530,7 +550,8 @@ def main(argv=None) -> int:
         help="rewrite the baseline to accept all current findings",
     )
     ap.add_argument(
-        "--rules", action="store_true", help="print the rule catalogue"
+        "--rules", "--list-rules", dest="rules", action="store_true",
+        help="print the rule catalogue (GC001-GC010)",
     )
     ap.add_argument(
         "--list", action="store_true", help="list program-catalog entries"

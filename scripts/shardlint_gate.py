@@ -6,6 +6,7 @@ Usage:
     python scripts/shardlint_gate.py path/to/file.py   # lint specific paths
     python scripts/shardlint_gate.py --self --write-baseline
     python scripts/shardlint_gate.py --rules           # print the catalogue
+    python scripts/shardlint_gate.py --list-rules      # alias of --rules
 
 ``--self`` lints the package, ``scripts/`` and ``tests/``. Exit status is
 nonzero iff a finding is NOT in the baseline file — so grandfathered
@@ -72,7 +73,8 @@ def main(argv=None) -> int:
         help="rewrite the baseline to accept all current findings",
     )
     ap.add_argument(
-        "--rules", action="store_true", help="print the rule catalogue"
+        "--rules", "--list-rules", dest="rules", action="store_true",
+        help="print the rule catalogue (SL001-SL008)",
     )
     args = ap.parse_args(argv)
 

@@ -251,7 +251,15 @@ def test_session_stage_list_covers_verdict_requirements(cs):
     } <= names
 
 
-@pytest.mark.parametrize("which,timeout", [("head", 180), ("ring", 300)])
+@pytest.mark.parametrize(
+    "which,timeout",
+    [
+        ("head", 180),
+        # tier-1 budget: the ring leg doubles the head leg's coverage of
+        # the stage driver; it rides in the slow tier
+        pytest.param("ring", 300, marks=pytest.mark.slow),
+    ],
+)
 def test_ab_stage_smoke(which, timeout):
     """The A/B stage scripts run end-to-end on the CPU plumbing tier and
     emit one parseable JSON record with the comparison fields."""

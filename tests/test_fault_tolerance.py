@@ -606,6 +606,9 @@ def _check_soak(chaos, base_out, plan):
     return [f[:3] for f in inj.fired]
 
 
+# tier-1 budget: each fault class has its own in-tier test; the
+# all-classes chaos soak joins chaos_soak_long in the slow tier
+@pytest.mark.slow
 def test_chaos_soak_all_fault_classes(params):
     plan = FaultPlan(
         seed=7, drafter_rate=0.05, alloc_rate=0.02, latency_rate=0.05,

@@ -251,6 +251,30 @@ def test_sampled_run_metrics_and_determinism(params):
     assert _run(_paged(params, gen, _cfg()), prompts) == out
 
 
+def test_audit_flags_corrupted_sampling_mirrors(params):
+    """Invariant 8 (serving/invariants.py): audit_engine cross-checks the
+    sampling mirrors against the lane roster. A free lane knocked off the
+    greedy park sentinel, an active lane whose params drift from the
+    GenerationConfig install, and a perturbed rng base key must each be
+    flagged; the untouched engine is clean."""
+    gen = GenerationConfig(max_new_tokens=16, sampling=SAMPLED)
+    paged = _paged(params, gen, _cfg())
+    for p in _prompts(np.random.default_rng(6), (5, 9)):
+        paged.submit(p)
+    for _ in range(4):
+        paged.step()
+    assert audit_engine(paged) == []
+    lane = next(iter(paged._active))
+    free = next(iter(paged._free_lanes))
+    paged._temps[free] = np.float32(0.7)  # knock the park sentinel
+    paged._topks[lane] = 7                # drift an active install
+    paged._rng[lane, 0] ^= np.uint32(1)   # perturb the replay key
+    v = audit_engine(paged)
+    assert any("not parked" in s for s in v)
+    assert any("do not match" in s for s in v)
+    assert any("SeedSequence base key" in s for s in v)
+
+
 def test_host_sampling_counts_fallbacks(params):
     gen = GenerationConfig(max_new_tokens=6, sampling=SAMPLED)
     prompts = _prompts(np.random.default_rng(5), (5, 9))

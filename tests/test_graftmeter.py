@@ -346,6 +346,10 @@ def test_meter_keeps_zero_upload_steady_state(params):
     assert m.decode_need_tokens > 0  # the meter did fold while resident
 
 
+# tier-1 budget: a wall-clock comparison needs repeated runs to beat
+# 1-cpu-host noise; the ≤5% contract rides the slow tier (the on/off
+# parity tests above stay in-tier)
+@pytest.mark.slow
 def test_meter_overhead_smoke(params):
     """Per-step host scheduling with the meter + cost profiles + SLO
     monitor armed stays within 5% (+0.2 ms absolute slack against CPU

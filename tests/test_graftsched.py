@@ -1,0 +1,325 @@
+"""graftsched: legality-automaton fixtures, mutation regressions, the
+explorer gate in-process, and policy equivalence.
+
+Layered like the other analyzer suites (test_shardlint / test_graftcheck):
+
+- **automaton unit fixtures** — one accepting and one rejecting flat
+  trace per edge of :data:`analysis.graftsched.AUTOMATON`, jax-free;
+- **trace replay** — ring-buffer seeding, recorded-depth drift
+  detection, the GC010 teardown entry point and its suppress switch;
+- **seeded mutations** — both historical-bug transforms fire on a
+  hand-built trace and raise when the trace has no applicable site;
+- **the CI gate in-process** — ``scripts/graftsched_gate.py`` explores
+  seeded schedules against a live tiny engine and must exit 0;
+- **policy equivalence** — an explicitly constructed FifoPolicy is
+  byte-for-byte the engine default (streams, upload counts, compiled
+  program set), and ``make_policy`` rejects unknown names.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+from neuronx_distributed_llama3_2_tpu.analysis.graftsched import (
+    AUTOMATON,
+    KNOWN_MUTATIONS,
+    ScheduleState,
+    advance,
+    check_action_trace,
+    check_flat,
+    check_trace,
+    flatten_trace,
+    run_seeded_mutations,
+)
+from neuronx_distributed_llama3_2_tpu.serving.policy import (
+    ActionType,
+    FifoPolicy,
+    StepAction,
+    make_policy,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def A(t, mode="", **meta):
+    return StepAction(ActionType(t), mode=mode, meta=meta)
+
+
+# -- automaton unit fixtures: accept + reject per edge ----------------------
+
+
+def test_sync_step_shape_accepted():
+    """The canonical drained FIFO step: readback, admit, prefill, flush,
+    dispatch — legal from an in-flight start (async steady state)."""
+    assert check_flat([
+        A("READBACK", lag=1),
+        A("ADMIT", lanes=[0, 1]),
+        A("PREFILL_CHUNK", lanes=[0]),
+        A("LANE_SET_FLUSH", lanes=[0, 1]),
+        A("DECODE_DISPATCH", mode="sync", lanes=[0, 1]),
+    ], start_outstanding=1) == []
+
+
+def test_async_lookahead_depth_one_accepted():
+    """Dispatch N+1 before reading N back: transient depth 2 at the
+    dispatch is the async pipeline's steady state and must be legal."""
+    assert check_flat([
+        A("DECODE_DISPATCH", mode="async", lanes=[0]),
+        A("READBACK", lag=1),
+        A("DECODE_DISPATCH", mode="async", lanes=[0]),
+        A("READBACK", lag=1),
+    ], start_outstanding=1) == []
+
+
+def test_admit_and_prefill_require_drained():
+    for t in ("ADMIT", "PREFILL_CHUNK"):
+        v = check_flat([A(t, lanes=[0])], start_outstanding=1)
+        assert len(v) == 1 and "in flight" in v[0].message, t
+        assert check_flat([A(t, lanes=[0])]) == []
+
+
+def test_dispatch_depth_capped_at_one():
+    v = check_flat([
+        A("DECODE_DISPATCH", lanes=[0]),
+        A("DECODE_DISPATCH", lanes=[0]),
+        A("DECODE_DISPATCH", lanes=[0]),
+    ])
+    assert len(v) == 1  # only the third exceeds the depth-1 pipeline
+    assert "lookahead depth 2" in v[0].message
+
+
+def test_dispatch_into_freed_lane_rejected():
+    """The host-state race behind GC010's name: FINISH releases lane 1's
+    blocks; a dispatch addressing that lane before re-admission races
+    host teardown against device KV writes."""
+    trace = [
+        A("FINISH", lane=1, rid=7),
+        A("DECODE_DISPATCH", mode="sync", lanes=[0, 1]),
+    ]
+    v = check_flat(trace)
+    assert len(v) == 1 and "freed lane(s) [1]" in v[0].message
+    # re-admission clears the lane: same dispatch becomes legal
+    trace.insert(1, A("ADMIT", lanes=[1]))
+    assert check_flat(trace) == []
+
+
+def test_verify_rules():
+    assert check_flat([A("VERIFY", lanes=[0])]) == []
+    v = check_flat([A("VERIFY", lanes=[0])], start_outstanding=1)
+    assert len(v) == 1 and "VERIFY with 1 step(s)" in v[0].message
+    v = check_flat([A("FINISH", lane=0, rid=1), A("VERIFY", lanes=[0])])
+    assert len(v) == 1 and "freed lane(s) [0]" in v[0].message
+
+
+def test_readback_rules():
+    assert check_flat([A("READBACK", lag=1)], start_outstanding=1) == []
+    v = check_flat([A("READBACK")])
+    assert len(v) == 1 and "nothing outstanding" in v[0].message
+    v = check_flat([A("READBACK", lag=2)], start_outstanding=1)
+    assert len(v) == 1 and "lag 2 > 1" in v[0].message
+
+
+def test_flush_rules():
+    """Full-lane syncs donate every resident — drained boundaries only;
+    single-entry table deltas are mid-flight-safe by construction."""
+    assert check_flat([A("LANE_SET_FLUSH", lanes=[0])]) == []
+    v = check_flat([A("LANE_SET_FLUSH", lanes=[0])], start_outstanding=1)
+    assert len(v) == 1 and "full-lane sync" in v[0].message
+    assert check_flat([A("TABLE_DELTA_FLUSH", lane=0)],
+                      start_outstanding=1) == []
+
+
+def test_release_requires_drained():
+    for t in ("FINISH", "PREEMPT"):
+        v = check_flat([A(t, lane=0, rid=3)], start_outstanding=1)
+        assert len(v) == 1 and "block release" in v[0].message, t
+        assert check_flat([A(t, lane=0, rid=3)]) == []
+
+
+def test_audit_always_legal():
+    assert check_flat([A("AUDIT")], start_outstanding=1) == []
+
+
+def test_advance_does_not_cascade():
+    """One bad transition advances the state anyway, so a single missing
+    drain yields one finding, not a spurious avalanche downstream."""
+    state = ScheduleState(outstanding=1)
+    v = advance(state, A("FINISH", lane=0, rid=1), "t")
+    assert len(v) == 1 and state.freed == {0}
+    assert state.outstanding == 1  # release does not eat the dispatch
+
+
+# -- engine-format trace replay ---------------------------------------------
+
+
+def _step(idx, pending, *actions):
+    return (idx, pending, list(actions))
+
+
+def test_check_trace_seeds_from_ring_buffer_head():
+    """The ring buffer may have dropped early steps: the first retained
+    entry's pending flag seeds the modeled depth, so a trace starting
+    mid-pipeline replays clean."""
+    assert check_trace([
+        _step(40, True, A("READBACK", lag=1),
+              A("DECODE_DISPATCH", mode="sync", lanes=[0])),
+        _step(41, True, A("READBACK", lag=1)),
+    ]) == []
+
+
+def test_check_trace_flags_recorded_depth_drift():
+    """A later entry whose recorded pending flag disagrees with the model
+    means an emission site went missing in engine.py — flagged once, then
+    resynced so downstream findings stay honest."""
+    v = check_trace([
+        _step(1, False, A("DECODE_DISPATCH", mode="sync", lanes=[0])),
+        _step(2, False, A("ADMIT", lanes=[])),  # model says depth 1
+    ])
+    assert len(v) == 1
+    assert "recorded lookahead depth 0 != modeled 1" in v[0].message
+
+
+class _FakeEngine:
+    def __init__(self, trace, pending):
+        self.action_trace = trace
+        self._pending = pending
+
+
+def test_check_action_trace_terminal_depth_and_suppress():
+    trace = [_step(1, False, A("DECODE_DISPATCH", mode="sync", lanes=[0]))]
+    eng = _FakeEngine(trace, pending=None)  # modeled 1 vs live 0
+    v = check_action_trace(eng)
+    assert any("live engine depth 0" in f.message for f in v)
+    assert check_action_trace(eng, suppress=("GC010",)) == []
+    eng = _FakeEngine(trace, pending=("step", [0]))
+    assert check_action_trace(eng) == []
+
+
+def test_findings_carry_rule_and_fingerprint():
+    (f,) = check_flat([A("READBACK")])
+    assert f.rule == "GC010"
+    assert len(f.fingerprint) == 12
+    assert "hint:" in f.format()
+
+
+# -- seeded mutations --------------------------------------------------------
+
+
+def _legal_trace():
+    """Engine-format trace with a finish after a readback and async
+    dispatches: sites for both known mutations."""
+    return [
+        _step(1, False, A("ADMIT", lanes=[0, 1]),
+              A("PREFILL_CHUNK", lanes=[0, 1]),
+              A("LANE_SET_FLUSH", lanes=[0, 1]),
+              A("DECODE_DISPATCH", mode="sync", lanes=[0, 1]),
+              A("READBACK", lag=0)),
+        _step(2, False, A("DECODE_DISPATCH", mode="async", lanes=[0, 1])),
+        _step(3, True, A("READBACK", lag=1),
+              A("FINISH", lane=1, rid=1),
+              A("DECODE_DISPATCH", mode="sync", lanes=[0])),
+    ]
+
+
+def test_mutations_caught_on_hand_built_trace():
+    trace = _legal_trace()
+    start, flat = flatten_trace(trace)
+    assert start == 0 and check_flat(flat) == []
+    results = run_seeded_mutations(trace, seed=0)
+    assert set(results) == set(KNOWN_MUTATIONS)
+    for name, findings in results.items():
+        assert findings, f"mutation {name} not caught"
+    caught = {n: {f.message for f in fs} for n, fs in results.items()}
+    assert any("block release" in m
+               for m in caught["release-before-lame-duck-drain"])
+    assert any("full-lane sync" in m
+               for m in caught["lane-set-mid-pipeline"])
+
+
+def test_mutations_raise_on_thin_trace():
+    """A workload with no finishes/dispatches certifies nothing; the
+    mutation runner refuses rather than vacuously passing."""
+    with pytest.raises(ValueError, match="no applicable site"):
+        run_seeded_mutations([_step(1, False, A("ADMIT", lanes=[]))])
+
+
+# -- the CI gate, in-process -------------------------------------------------
+
+
+def _load_gate():
+    spec = importlib.util.spec_from_file_location(
+        "graftsched_gate",
+        os.path.join(REPO_ROOT, "scripts", "graftsched_gate.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gate_main_in_process(capsys):
+    """The full gate — FIFO baseline + seeded schedules with per-action
+    audits, pure trace replay, both mutation regressions — exits 0."""
+    gate = _load_gate()
+    assert gate.main(["--schedules", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "graftsched: clean" in out
+    assert "2 mutation(s) caught" in out
+
+
+def test_gate_list_rules(capsys):
+    gate = _load_gate()
+    assert gate.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "GC010" in out
+    for edge in AUTOMATON:
+        assert edge["action"] in out
+
+
+# -- policy equivalence ------------------------------------------------------
+
+
+def test_make_policy_registry():
+    assert type(make_policy("fifo")) is FifoPolicy
+    with pytest.raises(ValueError, match="unknown step_policy"):
+        make_policy("round-robin")
+
+
+def test_explicit_fifo_policy_is_engine_default():
+    """PagedConfig(step_policy='fifo'), policy=FifoPolicy() and the bare
+    default must be indistinguishable: identical streams, upload counts
+    and compiled-program sets."""
+    gate = _load_gate()
+    factory = gate.make_engine_factory()
+
+    def run(policy):
+        eng = factory(policy)
+        out = eng.run_to_completion()
+        assert check_action_trace(eng) == []
+        return eng, out
+
+    eng_default, out_default = run(None)
+    eng_fifo, out_fifo = run(FifoPolicy())
+    assert out_fifo == out_default
+    assert (eng_fifo.metrics.h2d_uploads
+            == eng_default.metrics.h2d_uploads)
+    assert (set(eng_fifo._programs.keys())
+            == set(eng_default._programs.keys()))
+
+
+# -- docs parity -------------------------------------------------------------
+
+
+def test_docs_list_every_rule():
+    """docs/static_analysis.md documents every exported rule id — the
+    SL catalogue, the GC catalogue, and every automaton action — so
+    ``--list-rules`` and the docs cannot drift apart silently."""
+    from neuronx_distributed_llama3_2_tpu.analysis.graftcheck import GC_RULES
+    from neuronx_distributed_llama3_2_tpu.analysis.shardlint import RULES
+
+    with open(os.path.join(REPO_ROOT, "docs", "static_analysis.md")) as fh:
+        doc = fh.read()
+    for rule in list(RULES) + list(GC_RULES):
+        assert rule in doc, f"{rule} missing from docs/static_analysis.md"
+    for edge in AUTOMATON:
+        assert edge["action"] in doc

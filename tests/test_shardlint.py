@@ -463,6 +463,75 @@ def test_sl007_donate_argnames_spelling_fires():
     assert [f.rule for f in fs if f.rule == "SL007"] == ["SL007"]
 
 
+# ---------------------------------------------------------------- SL008
+
+
+_SERVING = "neuronx_distributed_llama3_2_tpu/serving/engine.py"
+
+
+def test_sl008_mirror_write_outside_funnel_fires():
+    src = """
+    class Engine:
+        def _my_new_path(self, lane):
+            self._positions[lane] += 1  # poking the frontier mirror
+    """
+    fs = lint_source(textwrap.dedent(src), path=_SERVING)
+    fs = [f for f in fs if f.rule == "SL008"]
+    assert len(fs) == 1
+    assert "_positions" in fs[0].message
+
+
+def test_sl008_resident_and_tuple_targets_fire():
+    src = """
+    class Engine:
+        def refresh(self, x):
+            self._d_tokens = x            # resident outside a funnel
+
+        def unpack(self, a, b):
+            self._tokens, other = a, b    # tuple-target mirror write
+    """
+    fs = [f for f in lint_source(textwrap.dedent(src), path=_SERVING)
+          if f.rule == "SL008"]
+    assert len(fs) == 2
+
+
+def test_sl008_blessed_funnels_and_other_layers_quiet():
+    src = """
+    class Engine:
+        def _read_and_apply(self, lane):
+            self._positions[lane] -= 1    # mirror funnel
+
+        def _flush_state(self, x):
+            self._d_tokens = x            # resident funnel
+
+        def _my_new_path(self):
+            self._scratch = 0             # unprotected attr: fine
+    """
+    fs = lint_source(textwrap.dedent(src), path=_SERVING)
+    assert [f for f in fs if f.rule == "SL008"] == []
+    # the same rogue write OUTSIDE serving/ is not SL008's business
+    outside = """
+    class Thing:
+        def poke(self, lane):
+            self._positions[lane] = 0
+    """
+    fs = lint_source(
+        textwrap.dedent(outside),
+        path="neuronx_distributed_llama3_2_tpu/inference/runner.py",
+    )
+    assert [f for f in fs if f.rule == "SL008"] == []
+
+
+def test_sl008_line_suppression():
+    src = """
+    class Engine:
+        def _my_new_path(self, lane):
+            self._positions[lane] = 0  # shardlint: disable=SL008
+    """
+    fs = lint_source(textwrap.dedent(src), path=_SERVING)
+    assert [f for f in fs if f.rule == "SL008"] == []
+
+
 # ----------------------------------------------------------- machinery
 
 
@@ -502,6 +571,7 @@ def test_load_axis_env_matches_state_py():
 def test_rule_catalogue_complete():
     assert sorted(RULES) == [
         "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
+        "SL008",
     ]
 
 

@@ -184,16 +184,18 @@ def matrix_ref(params):
     return prompts, _dense_outputs(params, prompts, MATRIX_GEN)
 
 
-# tier-1 time budget: the default tier runs a pairwise-covering quartet
-# (every chunk/async/spec value appears with every other value at least
-# once); the other half of the cube rides in the slow tier.
+# tier-1 time budget: the default tier runs a trio covering every
+# chunk/async/spec value and most pairs (whole-sync-spec completes the
+# pairwise quartet from the slow tier); the rest of the cube also rides
+# in the slow tier.
 @pytest.mark.parametrize(
     "chunk,async_loop,spec",
     [
         pytest.param(6, True, 3, id="chunked-async-spec"),
         pytest.param(6, False, 0, id="chunked-sync-plain"),
         pytest.param(None, True, 0, id="whole-async-plain"),
-        pytest.param(None, False, 3, id="whole-sync-spec"),
+        pytest.param(None, False, 3, id="whole-sync-spec",
+                     marks=pytest.mark.slow),
         pytest.param(6, False, 3, id="chunked-sync-spec",
                      marks=pytest.mark.slow),
         pytest.param(6, True, 0, id="chunked-async-plain",

@@ -411,6 +411,10 @@ def test_tracing_overhead_smoke(params):
 # ---------------------------------------------------------------------------
 
 
+# tier-1 budget: every contract this acceptance soak spans (trace
+# validity, phase coverage, export) has a dedicated in-tier test above;
+# the 200-step all-features run rides the slow tier
+@pytest.mark.slow
 def test_mixed_soak_exports_valid_chrome_trace(params, tmp_path):
     rng = np.random.default_rng(1234)
     gen = GenerationConfig(max_new_tokens=14)
