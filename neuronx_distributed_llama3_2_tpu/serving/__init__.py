@@ -33,6 +33,7 @@ from neuronx_distributed_llama3_2_tpu.serving.drafter import (
     NGramDrafter,
 )
 from neuronx_distributed_llama3_2_tpu.serving.engine import (
+    SERVICE_CLASSES,
     PagedConfig,
     PagedServingEngine,
     make_serving_engine,
@@ -56,6 +57,7 @@ from neuronx_distributed_llama3_2_tpu.serving.policy import (
     EngineView,
     FifoPolicy,
     POLICIES,
+    QueuedRequest,
     StepAction,
     StepPolicy,
     make_policy,
@@ -64,6 +66,10 @@ from neuronx_distributed_llama3_2_tpu.serving.policy import (
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
     RadixPrefixIndex,
 )
+# importing the scheduler registers SloPolicy in POLICIES, so
+# PagedConfig(step_policy="slo") / make_policy("slo") work out of the box
+from neuronx_distributed_llama3_2_tpu.serving.scheduler import SloPolicy
+from neuronx_distributed_llama3_2_tpu.serving.server import GraftServer
 from neuronx_distributed_llama3_2_tpu.serving.slo import (
     SLOMonitor,
     SLOPolicy,
@@ -77,9 +83,13 @@ __all__ = [
     "FAULT_KINDS",
     "NULL_BLOCK",
     "POLICIES",
+    "SERVICE_CLASSES",
     "ActionType",
     "EngineView",
     "FifoPolicy",
+    "GraftServer",
+    "QueuedRequest",
+    "SloPolicy",
     "StepAction",
     "StepPolicy",
     "make_policy",

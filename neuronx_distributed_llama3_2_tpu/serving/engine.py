@@ -305,6 +305,12 @@ class PagedConfig:
     step_policy: str = "fifo"
 
 
+#: graftserve service classes a request may be submitted under. The class
+#: is a scheduling hint for SLO-aware policies (serving/scheduler.py) and
+#: a metrics label; it never reaches the device path.
+SERVICE_CLASSES = frozenset({"interactive", "batch"})
+
+
 @dataclasses.dataclass
 class _PagedRequest:
     rid: int
@@ -344,6 +350,13 @@ class _PagedRequest:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     prefill_ms: float = 0.0                # cumulative across re-admissions
+    # graftserve admission metadata: the service class routes the request
+    # into a latency tier (interactive = TTFT-sensitive, batch =
+    # throughput) and the tenant is the fairness principal an SLO-aware
+    # policy stripes admission across. Pure scheduling hints — the FIFO
+    # policy and the device path never read them.
+    service_class: str = "batch"
+    tenant: str = "default"
 
 
 class PagedServingEngine:
@@ -1392,9 +1405,9 @@ class PagedServingEngine:
         chunk of its first admission): stamp TTFT."""
         if req.first_token_at is None:
             req.first_token_at = time.perf_counter()
-            self.metrics.hist_ttft_ms.observe(
-                (req.first_token_at - req.submitted_at) * 1e3
-            )
+            ms = (req.first_token_at - req.submitted_at) * 1e3
+            self.metrics.hist_ttft_ms.observe(ms)
+            self.metrics.observe_class_latency("ttft", req.service_class, ms)
 
     def _note_terminal(self, req: _PagedRequest) -> None:
         """Terminal transition (finished or failed): stamp the end time and
@@ -1404,10 +1417,15 @@ class PagedServingEngine:
             return
         req.finished_at = time.perf_counter()
         if req.first_token_at is not None and len(req.out) > 1:
-            self.metrics.hist_tpot_ms.observe(
+            ms = (
                 (req.finished_at - req.first_token_at) * 1e3
                 / (len(req.out) - 1)
             )
+            self.metrics.hist_tpot_ms.observe(ms)
+            self.metrics.observe_class_latency("tpot", req.service_class, ms)
+        self.metrics.note_class_event(
+            req.service_class, "failed" if req.failed else "finished"
+        )
 
     def _note_event(self) -> None:
         """Record one fault/pressure event for the degradation ladder."""
@@ -1716,7 +1734,18 @@ class PagedServingEngine:
 
     # -- request lifecycle -------------------------------------------------
 
-    def submit(self, prompt: Sequence[int]) -> int:
+    def submit(
+        self,
+        prompt: Sequence[int],
+        *,
+        service_class: str = "batch",
+        tenant: str = "default",
+    ) -> int:
+        if service_class not in SERVICE_CLASSES:
+            raise ValueError(
+                f"unknown service_class {service_class!r}; expected one of "
+                f"{sorted(SERVICE_CLASSES)}"
+            )
         if len(prompt) + self.gen.max_new_tokens > self.engine.max_seq_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -1739,12 +1768,52 @@ class PagedServingEngine:
         req = _PagedRequest(
             rid=rid, prompt=list(prompt), out=[],
             submitted_at=time.perf_counter(),
+            service_class=service_class, tenant=tenant,
         )
         self._queue.append(req)
         self._requests[rid] = req
         self.metrics.submitted += 1
+        self.metrics.note_class_event(service_class, "submitted")
+        self.metrics.queued_requests = len(self._queue)
         self.tracer.request_state(rid, "queued")
         return rid
+
+    def cancel(self, rid: int, reason: str = "cancelled by client") -> bool:
+        """Client-initiated terminal cancel (graftserve front door).
+
+        Routes through the existing failure domain: drain any in-flight
+        lookahead (``_fail_request`` is only legal pipeline-drained), then
+        fail the request with ``error=reason`` — blocks released, lane
+        freed and mirrors nulled through ``_release_lane``, FINISH
+        (failed=True) emitted for the action trace, terminal timing
+        stamped. Queued, prefilling, and decoding requests all take the
+        same path; survivors' resident state is untouched, so their token
+        streams are unchanged (cancellation-parity tests pin this).
+
+        Returns True if the request transitioned to terminal now, False
+        if it was already done. Raises KeyError for an unknown rid. Must
+        be called between steps (same threading contract as submit)."""
+        req = self._requests.get(rid)
+        if req is None:
+            raise KeyError(f"unknown request id {rid}")
+        if req.done:
+            return False
+        self._drain_pending()
+        self._fail_request(req, reason)
+        self.metrics.cancelled_requests += 1
+        self.metrics.queued_requests = len(self._queue)
+        return True
+
+    def _reorder_queue(self, order: Sequence[int]) -> None:
+        """Reorder the waiting queue to match ``order`` (a ranking of rids
+        from a policy's ADMIT ``admit_order`` meta). Rids absent from the
+        queue are ignored (finished/cancelled since the policy read its
+        view); queued requests absent from ``order`` keep their relative
+        FCFS order behind the ranked ones — a policy can promote without
+        being able to lose requests."""
+        by_rid = {r.rid: r for r in self._queue}
+        ranked = [by_rid.pop(rid) for rid in order if rid in by_rid]
+        self._queue = ranked + [r for r in self._queue if r.rid in by_rid]
 
     def _admit(self) -> None:
         """Admission wave, wrapped in one flight-recorder slice when there
@@ -1946,7 +2015,7 @@ class PagedServingEngine:
         )
         return int(self._read_tokens(tok)[0])
 
-    def _advance_prefills(self) -> None:
+    def _advance_prefills(self, budget_tokens: Optional[int] = None) -> None:
         """One fixed-budget chunk per prefilling lane per step (Sarathi-Serve
         chunked prefill): each chunk runs through the existing suffix-prefill
         program starting at ``prefill_pos``, so all non-final chunks of a
@@ -1954,12 +2023,27 @@ class PagedServingEngine:
         sampled token is discarded on non-final chunks — only the final
         chunk's logits are the real next-token distribution — and bucket
         padding is safe for the same reason it always was: padded writes
-        land at rows a later chunk overwrites before any mask admits them."""
+        land at rows a later chunk overwrites before any mask admits them.
+
+        ``budget_tokens`` (graftserve, via PREFILL_CHUNK action meta) caps
+        the *aggregate* prefill tokens this wave dispatches: once at least
+        one chunk ran and the budget is spent, remaining prefilling lanes
+        wait for the next step. At least one lane always advances when any
+        lane is prefilling — a budget can pace prefill, never starve it.
+        ``None`` (the default, and the only value FIFO ever passes) is the
+        historical unbounded wave, byte-for-byte."""
         chunk = self.paged.prefill_chunk_tokens
         bs = self.paged.block_size
+        spent = 0
         for lane, req in list(self._active.items()):
             if not req.prefilling:
                 continue
+            if (
+                budget_tokens is not None
+                and spent > 0
+                and spent >= budget_tokens
+            ):
+                break
             seq = req.prompt + req.out
             start = req.prefill_pos
             piece = seq[start: start + chunk]
@@ -1994,6 +2078,7 @@ class PagedServingEngine:
                     pad=self._last_prefill_bucket - max(len(piece), 1),
                 )
             req.prefill_pos = start + len(piece)
+            spent += len(piece)
             self.metrics.prefill_tokens += len(piece)
             self.metrics.prefill_chunks += 1
             self._emit_action(
@@ -2636,9 +2721,18 @@ class PagedServingEngine:
         if t is ActionType.READBACK:
             self._drain_pending()
         elif t is ActionType.ADMIT:
+            # graftserve: a policy may rank the waiting queue before the
+            # wave runs (meta["admit_order"] = rids, from view.queued()).
+            # The wave itself is unchanged — still strict head-of-line
+            # over the (re)ordered queue, so block accounting and the
+            # admit_blocked semantics are identical.
+            order = act.meta.get("admit_order") if act.meta else None
+            if order is not None:
+                self._reorder_queue(order)
             self._admit()
         elif t is ActionType.PREFILL_CHUNK:
-            self._advance_prefills()
+            budget = act.meta.get("budget_tokens") if act.meta else None
+            self._advance_prefills(budget_tokens=budget)
         elif t is ActionType.VERIFY:
             self._last_verify_drafted = self._verify_phase()
         elif t is ActionType.DECODE_DISPATCH:
@@ -2731,6 +2825,7 @@ class PagedServingEngine:
         self.metrics.host_schedule_ms += max(total_ms - self._wait_ms, 0.0)
         self.metrics.hist_step_ms.observe(total_ms)
         self.metrics.hist_queue_depth.observe(len(self._queue))
+        self.metrics.queued_requests = len(self._queue)
         if self._slo is not None:
             # SLO burn evaluation BEFORE the ladder update so a raised
             # alert's _note_event lands in the same step's event window
@@ -2795,6 +2890,16 @@ class PagedServingEngine:
             return "preempted" if req.preemptions else "queued"
         return "prefilling" if req.prefilling else "active"
 
+    def request_tokens(self, rid: int) -> List[int]:
+        """Copy of the tokens generated so far for ``rid``, in any
+        lifecycle state — the graftserve streaming path diffs this
+        between steps to emit token deltas. O(tokens); never blocks on
+        the device (``out`` is host state committed by readbacks)."""
+        req = self._requests.get(rid)
+        if req is None:
+            raise KeyError(f"unknown request id {rid}")
+        return list(req.out)
+
     def request_info(self, rid: int) -> dict:
         """Per-request serving stats (``cached_tokens`` is the per-request
         prefix-cache report the protocol layer surfaces). O(1): every
@@ -2835,6 +2940,8 @@ class PagedServingEngine:
             "done": req.done,
             "status": self._status(req),
             "error": req.error,
+            "service_class": req.service_class,
+            "tenant": req.tenant,
             "submitted_at": req.submitted_at,
             "first_token_at": req.first_token_at,
             "finished_at": req.finished_at,

@@ -36,6 +36,8 @@ _GAUGE_FIELDS = frozenset({
     "cost_profiled_programs", "hbm_budget_bytes", "hbm_footprint_bytes",
     "hbm_headroom_bytes", "peak_flops_per_chip", "peak_hbm_bw_per_chip",
     "slo_burn_ttft", "slo_burn_tpot",
+    # graftserve front-door gauges (rewritten every step / stream event)
+    "queued_requests", "active_streams",
 })
 
 # snapshot key -> hist_* field name (the stable public names dashboards
@@ -134,6 +136,17 @@ class ServingMetrics:
     slo_alerts: int = 0            # evaluations that raised a burn alert
     slo_burn_ttft: float = 0.0     # latest windowed TTFT burn rate (gauge)
     slo_burn_tpot: float = 0.0     # latest windowed TPOT burn rate (gauge)
+    # -- graftserve front door + SLO scheduler (serving/server.py,
+    #    serving/scheduler.py; docs/serving.md "Front door & scheduling"):
+    #    per-service-class accounting for the interactive/batch split the
+    #    SloPolicy schedules over, plus the server's stream gauges --
+    queued_requests: int = 0       # current waiting queue depth (gauge)
+    active_streams: int = 0        # open server token streams (gauge)
+    cancelled_requests: int = 0    # client-initiated terminal cancels
+    requests_by_class: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)  # class -> {submitted, finished, failed}
+    slo_burn_by_class: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)  # class -> {"ttft": burn, "tpot": burn}
     # -- fault tolerance (docs/serving.md "Failure handling & degradation") --
     faults_injected: int = 0       # chaos events fired by the FaultInjector
     failed_requests: int = 0       # requests ended in terminal `failed`
@@ -157,6 +170,39 @@ class ServingMetrics:
         default_factory=lambda: Histogram(1.0, 64.0, 2.0))
     hist_queue_depth: Histogram = dataclasses.field(
         default_factory=lambda: Histogram(1.0, 8192.0, 2.0))
+    # per-service-class latency distributions (created lazily as classes
+    # appear; hist_ prefix keeps them out of the flat snapshot — they
+    # surface through slo_burn_by_class and the load harness's asserts)
+    hist_ttft_by_class: Dict[str, Histogram] = dataclasses.field(
+        default_factory=dict)
+    hist_tpot_by_class: Dict[str, Histogram] = dataclasses.field(
+        default_factory=dict)
+
+    # -- graftserve per-class accounting (engine submit/terminal funnels) --
+
+    def note_class_event(self, service_class: str, event: str) -> None:
+        """Bump one per-class lifecycle counter (``submitted`` /
+        ``finished`` / ``failed``)."""
+        d = self.requests_by_class.get(service_class)
+        if d is None:
+            d = self.requests_by_class[service_class] = {
+                "submitted": 0, "finished": 0, "failed": 0,
+            }
+        d[event] += 1
+
+    def observe_class_latency(
+        self, kind: str, service_class: str, ms: float,
+    ) -> None:
+        """Fold one ttft/tpot observation into the class's histogram
+        (same ms bucket spec as the global ones)."""
+        hists = (
+            self.hist_ttft_by_class if kind == "ttft"
+            else self.hist_tpot_by_class
+        )
+        h = hists.get(service_class)
+        if h is None:
+            h = hists[service_class] = Histogram(0.05, 8e5, 2.0)
+        h.observe(ms)
 
     # -- graftmeter per-dispatch accounting (called from the engine's
     #    dispatch funnels; a few int adds + one dict hit, unconditional
@@ -273,6 +319,13 @@ class ServingMetrics:
         rec["mfu_by_rung"] = {
             rung: dict(v) for rung, v in sorted(self.mfu_by_rung.items())
         }
+        # graftserve per-class tables export as copies too
+        rec["requests_by_class"] = {
+            cls: dict(v) for cls, v in sorted(self.requests_by_class.items())
+        }
+        rec["slo_burn_by_class"] = {
+            cls: dict(v) for cls, v in sorted(self.slo_burn_by_class.items())
+        }
         rec["pad_waste_frac"] = self.pad_waste_frac()
         rec["decode_pad_frac"] = self._pad_frac(
             self.decode_pad_tokens, self.decode_need_tokens)
@@ -345,6 +398,25 @@ class ServingMetrics:
                 lines.append(
                     f'{base}_pad_frac_rung{{rung="{rung}"}} '
                     f'{v["pad_frac"]:g}')
+        # graftserve per-class families: lifecycle counters and burn gauges
+        # labelled by service class (docs/serving.md "Front door &
+        # scheduling")
+        rbc = snap.get("requests_by_class") or {}
+        if rbc:
+            lines.append("# TYPE serving_requests_class counter")
+        for cls in sorted(rbc):
+            for event in sorted(rbc[cls]):
+                lines.append(
+                    f'serving_requests_class{{class="{cls}",'
+                    f'event="{event}"}} {rbc[cls][event]:g}')
+        sbc = snap.get("slo_burn_by_class") or {}
+        if sbc:
+            lines.append("# TYPE serving_slo_burn_class gauge")
+        for cls in sorted(sbc):
+            for objective in sorted(sbc[cls]):
+                lines.append(
+                    f'serving_slo_burn_class{{class="{cls}",'
+                    f'objective="{objective}"}} {sbc[cls][objective]:g}')
         roofs = snap.get("mfu_by_rung") or {}
         if roofs:
             lines.append("# TYPE serving_roofline_mfu_rung gauge")

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Any, Dict, Iterator, Mapping, Type
+from typing import Any, Dict, Iterator, Mapping, NamedTuple, Tuple, Type
 
 
 class ActionType(enum.Enum):
@@ -87,13 +87,27 @@ class StepAction:
         return f"{tag}({kv})"
 
 
+class QueuedRequest(NamedTuple):
+    """One waiting request as a policy sees it (graftserve admission
+    metadata): ``position`` is the FCFS queue index, ``tokens`` the
+    sequence length the admission would have to place."""
+
+    rid: int
+    service_class: str
+    tenant: str
+    tokens: int
+    position: int
+
+
 class EngineView:
     """Read-only facade over the engine state a policy may consult.
 
     Policies never touch engine internals directly — everything a
     scheduling decision can depend on is a property here, so the legal
     observation surface is enumerable (and mockable in automaton unit
-    fixtures)."""
+    fixtures). Policies that want to *influence* engine behavior do it
+    through StepAction meta (``admit_order``, ``budget_tokens``), never
+    by mutating what they read here."""
 
     def __init__(self, engine) -> None:
         self._engine = engine
@@ -136,6 +150,70 @@ class EngineView:
         return sum(
             1 for r in self._engine._active.values() if r.prefilling
         )
+
+    @property
+    def free_lanes(self) -> int:
+        """Lanes an ADMIT this step could fill (0 → the wave is a no-op,
+        so ranking the queue would be wasted work)."""
+        return len(self._engine._free_lanes)
+
+    # -- graftserve scheduling surface (serving/scheduler.py) -------------
+
+    def queued(self) -> Tuple[QueuedRequest, ...]:
+        """The waiting queue in FCFS order, as read-only descriptors — the
+        admission-order input an SLO-aware policy ranks and hands back via
+        ``StepAction(ADMIT, meta={"admit_order": [...]})``."""
+        return tuple(
+            QueuedRequest(
+                rid=r.rid, service_class=r.service_class, tenant=r.tenant,
+                tokens=len(r.prompt) + len(r.out), position=i,
+            )
+            for i, r in enumerate(self._engine._queue)
+        )
+
+    @property
+    def prefill_buckets(self) -> tuple:
+        """The completed prefill bucket ladder (serving/catalog.py) every
+        prefill dispatch pads into — the rungs a chunked-prefill token
+        budget is quantized against."""
+        return tuple(self._engine._prefill_buckets)
+
+    @property
+    def catalog_description(self) -> str:
+        """``CatalogManifest.describe()`` for the engine's declared
+        ladders — the human-readable shape a budget heuristic can log."""
+        from neuronx_distributed_llama3_2_tpu.serving.catalog import (
+            CatalogManifest,
+        )
+
+        return CatalogManifest.from_engine(self._engine).describe()
+
+    def pad_by_rung(self, kind: str) -> Dict[int, dict]:
+        """Copy of the graftmeter pad-waste rung table (``kind`` is
+        ``"prefill"`` or ``"decode"``): rung -> {dispatches, need_tokens,
+        pad_tokens}. Copies — a policy can never mutate live counters."""
+        src = (
+            self._engine.metrics.prefill_pad_by_rung if kind == "prefill"
+            else self._engine.metrics.decode_pad_by_rung
+        )
+        return {rung: dict(v) for rung, v in src.items()}
+
+    @property
+    def slo_burn(self) -> Tuple[float, float]:
+        """Latest windowed (ttft, tpot) burn-rate gauges from the SLO
+        monitor (0.0 when no objective is declared)."""
+        m = self._engine.metrics
+        return (m.slo_burn_ttft, m.slo_burn_tpot)
+
+    @property
+    def slo_burn_by_class(self) -> Dict[str, dict]:
+        """Copy of the per-service-class burn gauges: class ->
+        {"ttft": burn, "tpot": burn} (absent keys = no observations for
+        that class yet)."""
+        return {
+            cls: dict(v)
+            for cls, v in self._engine.metrics.slo_burn_by_class.items()
+        }
 
     # -- outcomes of the most recent executed action (same step) ----------
 
@@ -241,6 +319,11 @@ register_policy(FifoPolicy)
 
 def make_policy(name: str) -> StepPolicy:
     """Instantiate a registered policy by name (``PagedConfig.step_policy``)."""
+    if name not in POLICIES:
+        # registration happens at module import; the non-FIFO policies
+        # live in serving/scheduler.py, which callers constructing an
+        # engine directly may not have imported yet
+        import neuronx_distributed_llama3_2_tpu.serving.scheduler  # noqa: F401
     try:
         cls = POLICIES[name]
     except KeyError:

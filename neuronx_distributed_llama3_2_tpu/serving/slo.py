@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from neuronx_distributed_llama3_2_tpu.serving.histogram import Histogram
 from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
@@ -129,6 +129,36 @@ class SLOMonitor:
                 "tpot", policy.tpot_p99_ms, metrics.hist_tpot_ms,
                 policy.window_evals,
             ))
+        # per-service-class burn gauges (graftserve): advisory objectives
+        # against the same declared targets, created lazily as classes
+        # appear in the per-class histograms. They update
+        # metrics.slo_burn_by_class for the SloPolicy scheduler and the
+        # dashboard but never alert and never feed the degradation ladder
+        # — the global objectives above own the alerting contract.
+        self._class_objectives: Dict[Tuple[str, str], _Objective] = {}
+
+    def _evaluate_classes(self, budget: float) -> None:
+        for kind, target, hists in (
+            ("ttft", self.policy.ttft_p99_ms,
+             self.metrics.hist_ttft_by_class),
+            ("tpot", self.policy.tpot_p99_ms,
+             self.metrics.hist_tpot_by_class),
+        ):
+            if target is None:
+                continue
+            for cls, hist in hists.items():
+                key = (kind, cls)
+                obj = self._class_objectives.get(key)
+                if obj is None:
+                    obj = self._class_objectives[key] = _Objective(
+                        f"{kind}/{cls}", target, hist,
+                        self.policy.window_evals,
+                    )
+                burn = obj.evaluate(budget)
+                row = self.metrics.slo_burn_by_class.get(cls)
+                if row is None:
+                    row = self.metrics.slo_burn_by_class[cls] = {}
+                row[kind] = round(burn, 4)
 
     def on_step(
         self,
@@ -145,6 +175,7 @@ class SLOMonitor:
             return False
         burning = []
         budget = self.policy.budget
+        self._evaluate_classes(budget)
         for obj in self.objectives:
             burn = obj.evaluate(budget)
             if obj.name == "ttft":

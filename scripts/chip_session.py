@@ -96,6 +96,12 @@ STAGES = [
     # invariant audits (scripts/chaos_soak.py; fast CPU smoke in tier-1)
     ("chaos_soak",
      [PY, os.path.join(REPO, "scripts", "chaos_soak.py")], 600),
+    # graftserve load: 10k+ mixed-class requests through the fifo-vs-slo
+    # comparison legs plus concurrent asyncio streaming clients, gated on
+    # interactive p99 TTFT improving under SloPolicy at <=5% tokens/step
+    # cost (scripts/serving_load.py; --smoke leg runs in tier-1)
+    ("serving_load",
+     [PY, os.path.join(REPO, "scripts", "serving_load.py")], 1200),
     ("churn_1b",
      [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
       "--stage", "churn", "--model", "llama3.2-1b"], 900),

@@ -69,10 +69,21 @@ _PROMPT_LENS = (3, 6, 9, 4)
 _STATE = None
 
 
-def make_engine_factory():
+#: (service_class, tenant) per prompt for the mixed-traffic SloPolicy leg:
+#: interleaved classes across two tenants, so the admission ranking has
+#: real reordering to do (queue depth 4 > 3 lanes).
+_MIXED_CLASSES = (
+    ("batch", "acme"), ("batch", "globex"),
+    ("interactive", "acme"), ("interactive", "globex"),
+)
+
+
+def make_engine_factory(mixed: bool = False):
     """engine_factory(policy) for :func:`analysis.graftsched.explore`:
     a fresh tiny async engine with the workload already submitted
-    (policy None = the engine-default FifoPolicy baseline)."""
+    (policy None = the engine-default FifoPolicy baseline). ``mixed``
+    submits the same prompts under the mixed service classes / tenants
+    the SloPolicy leg schedules over."""
     global _STATE
     import numpy as np
 
@@ -115,8 +126,12 @@ def make_engine_factory():
             policy=policy,
             precompile=False,
         )
-        for p in prompts:
-            eng.submit(p)
+        if mixed:
+            for p, (sc, tenant) in zip(prompts, _MIXED_CLASSES):
+                eng.submit(p, service_class=sc, tenant=tenant)
+        else:
+            for p in prompts:
+                eng.submit(p)
         return eng
 
     return factory
@@ -197,6 +212,41 @@ def main(argv=None) -> int:
                 "lost the rule this mutation exercises"
             )
             rc = 1
+
+    # SloPolicy leg: the SLO-aware scheduler (serving/scheduler.py) must
+    # emit GC010-clean schedules under mixed-class traffic, and its
+    # terminal token streams must match FIFO over the same workload —
+    # admission order moves *when* a request runs, never what it
+    # generates (per-lane attention + the per-request sampling install)
+    from neuronx_distributed_llama3_2_tpu.analysis.graftsched import (
+        _run_schedule,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving.scheduler import (
+        SloPolicy,
+    )
+
+    mixed = make_engine_factory(mixed=True)
+    base = _run_schedule(mixed, None, "fifo-mixed", 200)
+    slo = _run_schedule(mixed, SloPolicy(), "slo-mixed", 200)
+    for rep in (base, slo):
+        for f in rep.findings:
+            print(f.format())
+            rc = 1
+    if slo.streams != base.streams:
+        diff = sorted(
+            rid for rid in set(base.streams) | set(slo.streams)
+            if base.streams.get(rid) != slo.streams.get(rid)
+        )
+        print(
+            "graftsched: STREAM MISMATCH: slo-mixed diverges from "
+            f"fifo-mixed on rid(s) {diff}"
+        )
+        rc = 1
+    else:
+        print(
+            f"graftsched: slo leg: {slo.steps} step(s), "
+            f"{slo.actions} action(s), streams identical to fifo"
+        )
 
     if rc == 0:
         print(

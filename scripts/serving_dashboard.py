@@ -12,11 +12,19 @@ loop writes with ``json.dumps(m.snapshot(...))``.
 Usage:
   python scripts/serving_dashboard.py --file metrics.jsonl        # latest
   python scripts/serving_dashboard.py --file metrics.jsonl --follow
+  python scripts/serving_dashboard.py --prom metrics.prom         # exposition
+  python scripts/serving_dashboard.py --prom http://host:port/metrics
   python scripts/serving_dashboard.py --demo   # tiny CPU engine, live
 
-``--follow`` tails the file and redraws on every new record; ``--demo``
+``--follow`` tails the input and redraws on every new record; ``--demo``
 builds the tiny-model paged engine (CPU), drives a small workload, and
 renders as it goes — the zero-hardware smoke of the whole scrape path.
+``--prom`` accepts a prometheus text exposition instead of snapshot
+jsonl — a file, or an ``http(s)://`` URL scraped from a live
+:class:`~serving.server.GraftServer` ``/metrics`` endpoint — and
+reconstructs the snapshot shape (flat keys, per-class families,
+histogram percentiles re-interpolated from the cumulative buckets)
+before rendering the same panels.
 """
 
 from __future__ import annotations
@@ -61,6 +69,11 @@ def render_snapshot(snap: dict) -> str:
             f"preempted {g('preemptions', 0)}  truncated {g('truncated', 0)}"
         ),
         (
+            f"front door queued {g('queued_requests', 0)}  "
+            f"streams {g('active_streams', 0)}  "
+            f"cancelled {g('cancelled_requests', 0)}"
+        ),
+        (
             f"decode     steps {g('decode_steps', 0)} "
             f"(async {g('decode_steps_async', 0)}, "
             f"verify {g('verify_steps', 0)})  "
@@ -90,7 +103,8 @@ def render_snapshot(snap: dict) -> str:
     ]
     accept = g("accept_len")
     if accept and accept.get("count"):
-        lines.insert(9, _hist_row("accept", accept))
+        lines.insert(lines.index(_hist_row("queue", g("queue_depth", {}))),
+                     _hist_row("accept", accept))
     # graftmeter panels (docs/serving.md "Cost accounting & SLOs"): only
     # rendered when the snapshot carries the cost-accounting keys, so the
     # dashboard still draws pre-graftmeter records
@@ -131,7 +145,123 @@ def render_snapshot(snap: dict) -> str:
             f"slo        burn ttft {g('slo_burn_ttft', 0.0)}  "
             f"tpot {g('slo_burn_tpot', 0.0)}  alerts {g('slo_alerts', 0)}"
         )
+    # graftserve per-class panels (docs/serving.md "Front door &
+    # scheduling"): lifecycle counters and SLO burn per service class;
+    # the burn bar saturates at burn 1.0 — exactly consuming the budget
+    rbc = g("requests_by_class") or {}
+    if rbc:
+        row = "  ".join(
+            f"{cls}: sub {v.get('submitted', 0)} "
+            f"fin {v.get('finished', 0)} fail {v.get('failed', 0)}"
+            for cls, v in sorted(rbc.items())
+        )
+        lines.append(f"classes    {row}")
+    sbc = g("slo_burn_by_class") or {}
+    for cls in sorted(sbc):
+        burns = sbc[cls]
+        t = float(burns.get("ttft", 0.0) or 0.0)
+        p = float(burns.get("tpot", 0.0) or 0.0)
+        lines.append(
+            f"  burn/{cls:<9} ttft {t:>7.3f} [{_bar(t)}]  "
+            f"tpot {p:>7.3f} [{_bar(p)}]"
+        )
     return "\n".join(lines)
+
+
+def parse_prometheus(text: str) -> dict:
+    """Reconstruct a snapshot-shaped dict from a ``ServingMetrics``
+    prometheus exposition (the inverse of ``metrics.prometheus()``, to
+    rendering fidelity): flat ``serving_<key>`` samples become snapshot
+    keys, the per-class labelled families fold back into
+    ``requests_by_class`` / ``slo_burn_by_class``, the per-rung pad
+    families into ``*_pad_by_rung``, and each histogram's cumulative
+    buckets are re-interpolated into the p50/p90/p99 summary rows the
+    dashboard draws (the ``max`` of an exposition is unknowable — the
+    highest nonzero bucket edge stands in)."""
+    import re
+
+    flat: dict = {}
+    hists: dict = {}
+    labelled = re.compile(r'^(\w+)\{(.*)\} (\S+)$')
+
+    def _num(s: str):
+        v = float(s)
+        return int(v) if v.is_integer() else v
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = labelled.match(line)
+        if m:
+            name, labels_s, val = m.groups()
+            labels = dict(re.findall(r'(\w+)="([^"]*)"', labels_s))
+            if name == "serving_requests_class":
+                flat.setdefault("requests_by_class", {}) \
+                    .setdefault(labels["class"], {})[labels["event"]] = \
+                    _num(val)
+            elif name == "serving_slo_burn_class":
+                flat.setdefault("slo_burn_by_class", {}) \
+                    .setdefault(labels["class"], {})[labels["objective"]] = \
+                    float(val)
+            elif name.endswith("_pad_frac_rung"):
+                kind = "decode" if name.startswith("serving_decode") else "prefill"
+                flat.setdefault(f"{kind}_pad_by_rung", {}) \
+                    .setdefault(int(labels["rung"]), {})["pad_frac"] = \
+                    float(val)
+            elif name == "serving_roofline_mfu_rung":
+                flat.setdefault("mfu_by_rung", {}) \
+                    .setdefault(int(labels["rung"]), {})["roofline_mfu"] = \
+                    float(val)
+            elif name.endswith("_bucket") and "le" in labels:
+                base = name[: -len("_bucket")]
+                if labels["le"] != "+Inf":
+                    hists.setdefault(base, {"buckets": []})["buckets"] \
+                        .append((float(labels["le"]), float(val)))
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            continue
+        name, val = parts
+        if name.endswith("_sum") or name.endswith("_count"):
+            base, _, kind = name.rpartition("_")
+            if base.removeprefix("serving_") in (
+                "ttft_ms", "tpot_ms", "step_latency_ms", "accept_len",
+                "queue_depth",
+            ):
+                hists.setdefault(base, {"buckets": []})[kind] = float(val)
+                continue
+        if name.startswith("serving_"):
+            try:
+                flat[name[len("serving_"):]] = _num(val)
+            except ValueError:
+                pass
+
+    def _pct(buckets, count: float, q: float) -> float:
+        target = q * count
+        prev_edge, cum = 0.0, 0.0
+        for edge, cumulative in buckets:
+            n = cumulative - cum
+            if n > 0 and cumulative >= target:
+                frac = (target - cum) / n
+                return round(prev_edge + (edge - prev_edge) * frac, 4)
+            cum = cumulative
+            prev_edge = edge
+        return round(prev_edge, 4)
+
+    for base, h in hists.items():
+        key = base[len("serving_"):] if base.startswith("serving_") else base
+        count = h.get("count", 0.0)
+        buckets = sorted(h["buckets"])
+        flat[key] = {
+            "count": int(count),
+            "mean": round(h.get("sum", 0.0) / count, 4) if count else 0.0,
+            "max": buckets[-1][0] if buckets else 0.0,
+            "p50": _pct(buckets, count, 0.50) if count else 0.0,
+            "p90": _pct(buckets, count, 0.90) if count else 0.0,
+            "p99": _pct(buckets, count, 0.99) if count else 0.0,
+        }
+    return flat
 
 
 def _last_record(path: str) -> dict:
@@ -188,8 +318,14 @@ def _demo() -> int:
     # light up the capacity/MFU panels
     paged.ensure_cost_profiles()
     rng = __import__("numpy").random.default_rng(0)
-    for n in (5, 11, 7, 19):
-        paged.submit(rng.integers(1, cfg.vocab_size, size=n).tolist())
+    for i, n in enumerate((5, 11, 7, 19)):
+        paged.submit(
+            rng.integers(1, cfg.vocab_size, size=n).tolist(),
+            # mixed classes/tenants: the per-class panels render in the
+            # demo (burns stay 0.0 under the loose targets)
+            service_class="interactive" if i % 2 else "batch",
+            tenant=("acme", "globex")[i % 2],
+        )
     alive, steps = True, 0
     while alive:
         alive = paged.step()
@@ -204,11 +340,26 @@ def _demo() -> int:
     return 0
 
 
+def _read_prom(src: str) -> str:
+    if src.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(src, timeout=10) as resp:
+            return resp.read().decode()
+    with open(src) as f:
+        return f.read()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--file", help="jsonl file of snapshot records")
+    ap.add_argument(
+        "--prom",
+        help="prometheus exposition input: a file, or an http(s):// "
+        "/metrics endpoint (a live GraftServer scrape)",
+    )
     ap.add_argument("--follow", action="store_true",
-                    help="tail --file and redraw on new records")
+                    help="tail the input and redraw on new records")
     ap.add_argument("--interval", type=float, default=1.0,
                     help="poll interval for --follow (seconds)")
     ap.add_argument("--demo", action="store_true",
@@ -216,11 +367,25 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.demo:
         return _demo()
-    if not args.file:
-        ap.error("--file or --demo required")
+    if not args.file and not args.prom:
+        ap.error("--file, --prom, or --demo required")
+    if args.file and args.prom:
+        ap.error("--file and --prom are mutually exclusive")
+
+    def _render_once() -> None:
+        if args.prom:
+            print(render_snapshot(parse_prometheus(_read_prom(args.prom))))
+        else:
+            print(render_snapshot(_last_record(args.file)))
+
     if not args.follow:
-        print(render_snapshot(_last_record(args.file)))
+        _render_once()
         return 0
+    if args.prom:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            _render_once()
+            time.sleep(args.interval)
     last_size = -1
     while True:
         try:

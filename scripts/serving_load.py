@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""graftserve load harness: simulated clients against the front door.
+
+Two legs, both seeded and CPU-hosted on the tiny model:
+
+1. **Policy comparison** — the same mixed-class/mixed-tenant workload is
+   burst- (smoke) or wave- (full) submitted into two otherwise identical
+   engines, one under ``FifoPolicy`` and one under ``SloPolicy``, and the
+   run is gated on the graftscope histograms the engines observe into:
+
+   - every request finishes (zero failed/stuck), the action trace is
+     GC010-clean, ``audit_engine`` and ``leak_check`` are clean;
+   - the per-class TTFT histograms saw every request of their class;
+   - **interactive-class p99 TTFT improves under SloPolicy** while
+     aggregate tokens/step stays within 5% of FIFO — the acceptance bar
+     for an SLO scheduler that reorders admission without taxing
+     throughput.
+
+2. **Async streaming clients** — a :class:`~serving.server.GraftServer`
+   drives a third engine while concurrent asyncio clients submit, stream
+   tokens, and cancel mid-stream; gated on zero open streams at the end,
+   the expected cancel count, and the same invariant/automaton sweep.
+
+Usage:
+    python scripts/serving_load.py            # full: 10k+ requests
+    python scripts/serving_load.py --smoke    # tier-1: small, seconds
+    python scripts/serving_load.py --requests 2000 --seed 3
+
+``--smoke`` is what ``tests/test_server.py`` runs in-process; the full
+run is staged in ``scripts/chip_session.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+TENANTS = ("acme", "globex", "initech")
+
+
+def _configure_jax() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    cache = os.path.join(REPO_ROOT, "tests", ".jax_cache_serving_load")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass
+
+
+_STATE = None
+
+
+def make_engine_factory():
+    """engine_factory(policy_name) -> fresh tiny engine (shared params).
+
+    The largest prefill bucket (32) equals ``max_batch *
+    prefill_chunk_tokens``, so SloPolicy's bucket-quantized prefill
+    budget admits the same chunk wave FIFO runs — the throughput
+    comparison isolates *admission order*, which is the thing under
+    test."""
+    global _STATE
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    if _STATE is None:
+        import jax
+
+        cfg = LLAMA_CONFIGS["tiny"]
+        params = LlamaForCausalLM(cfg).init(jax.random.key(0))
+        _STATE = (cfg, params)
+    cfg, params = _STATE
+
+    def factory(policy_name: str) -> PagedServingEngine:
+        return PagedServingEngine(
+            InferenceEngine(
+                cfg, params, max_batch=4, max_seq_len=64,
+                buckets=[16, 32],
+            ),
+            GenerationConfig(max_new_tokens=6),
+            PagedConfig(
+                block_size=8, num_blocks=64, prefill_chunk_tokens=8,
+                async_loop=True, step_policy=policy_name,
+                # tight TTFT objective (burns under the burst, exercising
+                # the burn-feedback path) but a loose TPOT one: a burning
+                # TPOT clamps SloPolicy's prefill budget, which is decode
+                # protection, not what this comparison measures
+                slo_ttft_p99_ms=50.0, slo_tpot_p99_ms=10_000.0,
+                slo_eval_steps=8,
+            ),
+            precompile=False,
+        )
+
+    return factory
+
+
+def make_workload(seed: int, n_interactive: int, n_batch: int):
+    """Seeded mixed workload: (prompt, service_class, tenant) triples.
+    Batch requests lead and interactive trail — the FIFO worst case an
+    admission reorderer exists to fix."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    vocab = 128
+    work = []
+    for i in range(n_batch):
+        n = int(rng.integers(20, 29))
+        work.append((
+            rng.integers(0, vocab, size=(n,)).tolist(),
+            "batch", TENANTS[i % len(TENANTS)],
+        ))
+    for i in range(n_interactive):
+        n = int(rng.integers(4, 9))
+        work.append((
+            rng.integers(0, vocab, size=(n,)).tolist(),
+            "interactive", TENANTS[i % len(TENANTS)],
+        ))
+    return work
+
+
+def _audit_clean(eng, label: str) -> int:
+    """Invariant sweep at teardown: auditor + leak_check + automaton."""
+    from neuronx_distributed_llama3_2_tpu.analysis.graftsched import (
+        check_action_trace,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import audit_engine
+
+    rc = 0
+    for v in audit_engine(eng):
+        print(f"serving_load: {label}: AUDIT: {v}")
+        rc = 1
+    for bid in eng.allocator.leak_check():
+        print(f"serving_load: {label}: LEAK: block {bid}")
+        rc = 1
+    for f in check_action_trace(eng):
+        print(f"serving_load: {label}: {f.format()}")
+        rc = 1
+    return rc
+
+
+def run_policy_leg(factory, policy_name: str, workload, wave: int = 0):
+    """Run one engine under ``policy_name`` over the workload. ``wave``
+    > 0 paces submissions (that many per step — open-loop arrivals, so
+    the queue stays bounded on 10k-request runs); 0 bursts everything
+    up front (smoke: maximal head-of-line pressure)."""
+    eng = factory(policy_name)
+    todo = list(workload)
+    if not wave:
+        for prompt, sc, tenant in todo:
+            eng.submit(prompt, service_class=sc, tenant=tenant)
+        todo = []
+    t0 = time.perf_counter()
+    alive = True
+    while alive or todo:
+        for prompt, sc, tenant in todo[:wave]:
+            eng.submit(prompt, service_class=sc, tenant=tenant)
+        todo = todo[wave:] if wave else []
+        alive = eng.step()
+    wall = time.perf_counter() - t0
+    m = eng.metrics
+    steps = eng._step_index
+    gen_tokens = sum(len(r.out) for r in eng._finished.values())
+    stats = {
+        "finished": m.finished,
+        "failed": m.failed_requests,
+        "steps": steps,
+        "wall_s": round(wall, 3),
+        "tokens_per_step": (gen_tokens / steps) if steps else 0.0,
+        "ttft_by_class": {
+            cls: h.snapshot() for cls, h in sorted(m.hist_ttft_by_class.items())
+        },
+        "tpot_by_class": {
+            cls: h.snapshot() for cls, h in sorted(m.hist_tpot_by_class.items())
+        },
+        "slo_burn_by_class": dict(m.slo_burn_by_class),
+    }
+    rc = _audit_clean(eng, policy_name)
+    return eng, stats, rc
+
+
+def check_comparison(workload, fifo_stats, slo_stats) -> int:
+    """The fifo-vs-slo acceptance gates (see module docstring)."""
+    rc = 0
+    n_int = sum(1 for _, sc, _ in workload if sc == "interactive")
+    n_bat = len(workload) - n_int
+    for name, stats in (("fifo", fifo_stats), ("slo", slo_stats)):
+        if stats["failed"] or stats["finished"] != len(workload):
+            print(
+                f"serving_load: GATE: {name} finished={stats['finished']} "
+                f"failed={stats['failed']} of {len(workload)}"
+            )
+            rc = 1
+        got_int = stats["ttft_by_class"].get("interactive", {}).get("count", 0)
+        got_bat = stats["ttft_by_class"].get("batch", {}).get("count", 0)
+        if (got_int, got_bat) != (n_int, n_bat):
+            print(
+                f"serving_load: GATE: {name} ttft histogram counts "
+                f"({got_int} interactive, {got_bat} batch) != submitted "
+                f"({n_int}, {n_bat})"
+            )
+            rc = 1
+    fifo_p99 = fifo_stats["ttft_by_class"]["interactive"]["p99"]
+    slo_p99 = slo_stats["ttft_by_class"]["interactive"]["p99"]
+    if not slo_p99 < fifo_p99:
+        print(
+            f"serving_load: GATE: interactive p99 TTFT did not improve: "
+            f"slo {slo_p99}ms vs fifo {fifo_p99}ms"
+        )
+        rc = 1
+    tps_f, tps_s = fifo_stats["tokens_per_step"], slo_stats["tokens_per_step"]
+    if tps_f and tps_s < 0.95 * tps_f:
+        print(
+            f"serving_load: GATE: tokens/step regressed >5%: "
+            f"slo {tps_s:.3f} vs fifo {tps_f:.3f}"
+        )
+        rc = 1
+    print(
+        f"serving_load: interactive p99 TTFT {fifo_p99:.1f}ms (fifo) -> "
+        f"{slo_p99:.1f}ms (slo); tokens/step {tps_f:.3f} -> {tps_s:.3f}"
+    )
+    return rc
+
+
+async def run_async_leg(factory, n_clients: int, seed: int) -> int:
+    """Concurrent asyncio clients against a GraftServer: submit, stream,
+    and cancel every 5th request after two tokens."""
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.serving import GraftServer
+
+    rng = np.random.default_rng(seed)
+    eng = factory("slo")
+    rc = 0
+    cancelled = []
+
+    async def client(srv: GraftServer, i: int, prompt) -> None:
+        sc = "interactive" if i % 3 == 0 else "batch"
+        rid = srv.submit(
+            prompt, service_class=sc, tenant=TENANTS[i % len(TENANTS)]
+        )
+        cancel_at = 2 if i % 5 == 4 else None
+        got = 0
+        async for _tok in srv.stream(rid):
+            got += 1
+            if cancel_at is not None and got >= cancel_at:
+                srv.cancel(rid)
+                cancelled.append(rid)
+        resp = srv.response(rid)
+        if cancel_at is not None:
+            assert resp["error"] is not None, resp
+            assert resp["error"]["type"] == "cancelled", resp
+        else:
+            assert resp["status"] == "finished", resp
+
+    async with GraftServer(eng, idle_poll_s=0.002) as srv:
+        prompts = [
+            rng.integers(0, 128, size=(int(rng.integers(4, 24)),)).tolist()
+            for _ in range(n_clients)
+        ]
+        await asyncio.gather(*(
+            client(srv, i, p) for i, p in enumerate(prompts)
+        ))
+        snap = srv.snapshot()
+
+    n_cancel = sum(1 for i in range(n_clients) if i % 5 == 4)
+    if len(cancelled) != n_cancel:
+        print(
+            f"serving_load: GATE: async leg cancelled {len(cancelled)} "
+            f"!= expected {n_cancel}"
+        )
+        rc = 1
+    if snap["active_streams"] != 0:
+        print(
+            f"serving_load: GATE: async leg left "
+            f"{snap['active_streams']} open streams"
+        )
+        rc = 1
+    if snap["cancelled_requests"] != n_cancel:
+        print(
+            f"serving_load: GATE: cancelled_requests gauge "
+            f"{snap['cancelled_requests']} != {n_cancel}"
+        )
+        rc = 1
+    rc |= _audit_clean(eng, "async")
+    print(
+        f"serving_load: async leg: {n_clients} clients, "
+        f"{n_cancel} cancels, {snap['finished']} finished, "
+        f"active_streams={snap['active_streams']}"
+    )
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="tier-1 mode: small burst workload (seconds, in-process)",
+    )
+    ap.add_argument(
+        "--requests", type=int, default=None,
+        help="total requests for the comparison leg (default 10000 full, "
+        "32 smoke)",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--clients", type=int, default=None,
+        help="async streaming clients (default requests//10, min 12)",
+    )
+    args = ap.parse_args(argv)
+
+    total = args.requests or (32 if args.smoke else 10_000)
+    n_interactive = max(total // 4, 1)
+    n_batch = total - n_interactive
+    wave = 0 if args.smoke else 50
+    clients = args.clients or max(12, total // 10 if args.smoke else 500)
+
+    factory = make_engine_factory()
+    workload = make_workload(args.seed, n_interactive, n_batch)
+    rc = 0
+    _, fifo_stats, rc_f = run_policy_leg(factory, "fifo", workload, wave)
+    _, slo_stats, rc_s = run_policy_leg(factory, "slo", workload, wave)
+    rc |= rc_f | rc_s
+    rc |= check_comparison(workload, fifo_stats, slo_stats)
+    rc |= asyncio.run(run_async_leg(factory, clients, args.seed))
+    print(f"serving_load: {'FAIL' if rc else 'clean'} "
+          f"({total} requests, {clients} async clients)")
+    return rc
+
+
+if __name__ == "__main__":
+    _configure_jax()
+    sys.exit(main())

@@ -237,6 +237,9 @@ EXPECTED_SNAPSHOT_KEYS = {
     "hbm_budget_bytes", "hbm_footprint_bytes", "hbm_headroom_bytes",
     "peak_flops_per_chip", "peak_hbm_bw_per_chip", "mfu_by_rung",
     "slo_alerts", "slo_burn_ttft", "slo_burn_tpot",
+    # graftserve: front-door gauges + per-class lifecycle/burn tables
+    "queued_requests", "active_streams", "cancelled_requests",
+    "requests_by_class", "slo_burn_by_class",
     # derived
     "prefix_skip_fraction", "accept_rate", "host_schedule_ms_per_step",
     "device_wait_ms_per_step",
