@@ -1,6 +1,6 @@
-"""Static analysis for sharding/trace safety.
+"""Static analysis for sharding/trace/schedule safety.
 
-Two analyzers, two layers of the same story (docs/static_analysis.md):
+Four analyzers, four layers of the same story (docs/static_analysis.md):
 
 - ``shardlint`` is pure-AST: it never imports the modules it checks, so
   it runs on any host (no TPU, no jax initialization) and in CI as a
@@ -8,13 +8,22 @@ Two analyzers, two layers of the same story (docs/static_analysis.md):
 - ``graftcheck`` analyzes what the tracer/compiler actually produced —
   jaxprs and lowered programs. It imports jax (to trace) but never
   executes a program, so it too runs on the CPU tier.
+- ``graftsched`` analyzes what the serving engine actually *did* — the
+  recorded action trace — against the step-action automaton (GC010),
+  and explores candidate schedules through the live engine.
+- ``graftplan`` closes the loop offline: it replays recorded workloads
+  through a jax-free cost simulator, autotunes a policy vector over it,
+  and emits certified policy tables the engine only loads when their
+  GC011 freshness checks (certificate, automaton/ladder fingerprints)
+  pass.
 
-graftcheck names (``GC_RULES``, ``audit_programs``, the ``check_*``
-rules) are intentionally NOT re-exported here: its callers hold jaxprs
-and lowered programs already, and the shardlint surface must stay
-importable with zero jax involvement (graftcheck itself defers its jax
-imports to call time). Use
-``from neuronx_distributed_llama3_2_tpu.analysis import graftcheck``.
+graftcheck/graftsched/graftplan names (``GC_RULES``, ``audit_programs``,
+``check_action_trace``, ``check_policy_table``, ...) are intentionally
+NOT re-exported here: their callers hold jaxprs, traces or artifacts
+already, and the shardlint surface must stay importable with zero jax
+involvement (the others defer their jax imports to call time). Use
+``from neuronx_distributed_llama3_2_tpu.analysis import graftcheck``
+(or ``graftsched``, ``graftplan``).
 """
 
 from neuronx_distributed_llama3_2_tpu.analysis.shardlint import (

@@ -59,6 +59,14 @@ GC010  schedule legality: an engine's recorded step-action trace must
        The replay entry point is ``graftsched.check_action_trace``;
        it lives in the GC catalogue because it audits *recorded
        engine behavior* at teardown, exactly like audit_programs.
+GC011  policy-table freshness: a graftplan policy table may only load
+       with its explorer certificate present and GC010-clean, its
+       automaton and catalog-ladder fingerprints matching the live
+       engine, and every prefill chunk budget on the prefill ladder.
+       The check entry point is ``graftplan.check_policy_table`` (the
+       loaders raise ``PolicyTableError`` on any finding); it lives in
+       the GC catalogue because it gates *loading* a static artifact,
+       the mirror image of GC010 auditing a recorded trace.
 
 Suppression: jaxprs have no source lines to annotate, so suppression is
 per (program, rule) — pass ``suppress={"GC003", ...}`` to the check
@@ -121,6 +129,11 @@ GC_RULES: Dict[str, str] = {
     "GC010": (
         "recorded step-action trace rejected by the schedule legality "
         "automaton (analysis/graftsched.py)"
+    ),
+    "GC011": (
+        "policy table loaded without a fresh explorer certificate "
+        "(missing/unclean certificate, stale automaton or ladder "
+        "fingerprint, off-ladder budget; analysis/graftplan.py)"
     ),
 }
 

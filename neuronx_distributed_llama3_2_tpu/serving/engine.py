@@ -303,6 +303,12 @@ class PagedConfig:
     # schedules; the config knob stays a name so PagedConfig remains
     # hashable/frozen.
     step_policy: str = "fifo"
+    # path to a graftplan certified policy-table artifact
+    # (analysis/graftplan.py). Loaded at construction under GC011 —
+    # certificate present, automaton/ladder fingerprints fresh against
+    # *this* engine — and applied to the policy (which must be
+    # TablePolicy, i.e. step_policy="table"). None = no table.
+    policy_table_path: Optional[str] = None
 
 
 #: graftserve service classes a request may be submitted under. The class
@@ -357,6 +363,9 @@ class _PagedRequest:
     # policy and the device path never read them.
     service_class: str = "batch"
     tenant: str = "default"
+    # engine _step_index at submit() time: the workload-trace export
+    # (graftplan) replays arrivals at the same step boundary
+    submitted_step: int = 0
 
 
 class PagedServingEngine:
@@ -695,6 +704,21 @@ class PagedServingEngine:
             SLOMonitor(slo_policy, self.metrics) if slo_policy.active
             else None
         )
+        # graftplan certified policy table (analysis/graftplan.py):
+        # loaded before any warmup so a stale artifact fails fast, and
+        # checked against *this* engine's completed ladders (GC011). A
+        # caller-supplied policy instance that already carries a table
+        # (certification harness) is re-checked the same way.
+        # the artifact path is strict (a table from disk must carry a
+        # fresh certificate); a caller-supplied instance's table is
+        # advisory (stale gauge, no raise) so the certification harness
+        # can run a not-yet-stamped candidate live.
+        if paged.policy_table_path is not None:
+            self.load_policy_table(paged.policy_table_path)
+        elif getattr(self.policy, "table", None) is not None:
+            self.load_policy_table(
+                getattr(self.policy, "table"), strict=False
+            )
         if paged.prewarm:
             self.prewarm()
         elif precompile:
@@ -1769,6 +1793,7 @@ class PagedServingEngine:
             rid=rid, prompt=list(prompt), out=[],
             submitted_at=time.perf_counter(),
             service_class=service_class, tenant=tenant,
+            submitted_step=self._step_index,
         )
         self._queue.append(req)
         self._requests[rid] = req
@@ -1803,6 +1828,104 @@ class PagedServingEngine:
         self.metrics.cancelled_requests += 1
         self.metrics.queued_requests = len(self._queue)
         return True
+
+    # -- graftplan: workload export + policy-table load --------------------
+
+    def export_workload(self) -> Any:
+        """Serialize this engine's geometry and every submitted request
+        span as a :class:`~..analysis.graftplan.Workload` — the recorded
+        trace the graftplan simulator replays and the autotuner searches
+        over. Plain data (no arrays, no engine handles); call after the
+        run so the action-trace summary covers it."""
+        from neuronx_distributed_llama3_2_tpu.analysis.graftplan import (
+            Workload,
+            WorkloadRequest,
+        )
+        from neuronx_distributed_llama3_2_tpu.serving.accounting import (
+            EngineDims,
+        )
+
+        requests = [
+            WorkloadRequest(
+                rid=r.rid,
+                prompt_tokens=len(r.prompt),
+                max_new_tokens=self.gen.max_new_tokens,
+                service_class=r.service_class,
+                tenant=r.tenant,
+                submitted_step=r.submitted_step,
+            )
+            for r in sorted(self._requests.values(), key=lambda r: r.rid)
+        ]
+        trace = {
+            "steps": len(self.action_trace),
+            "actions": sum(
+                len(acts) for _, _, acts in self.action_trace
+            ),
+            "host_schedule_ms": self.metrics.host_schedule_ms,
+        }
+        return Workload(
+            block_size=self.paged.block_size,
+            num_blocks=self.paged.num_blocks,
+            decode_reserve_blocks=self.paged.decode_reserve_blocks,
+            lanes=self.engine.max_batch,
+            max_seq_len=self.engine.max_seq_len,
+            prefill_chunk_tokens=self.paged.prefill_chunk_tokens,
+            prefill_buckets=tuple(self._prefill_buckets),
+            kv_buckets=tuple(self._kv_buckets),
+            dims=EngineDims.from_engine(self),
+            requests=requests,
+            async_loop=self.paged.async_loop,
+            slo_ttft_p99_ms=self.paged.slo_ttft_p99_ms,
+            slo_tpot_p99_ms=self.paged.slo_tpot_p99_ms,
+            trace=trace,
+        )
+
+    def load_policy_table(self, source: Any, strict: bool = True) -> list:
+        """Install a graftplan policy table (path or parsed dict) on the
+        live step policy under GC011: certificate present and explorer-
+        clean, automaton fingerprint fresh, ladder fingerprint fresh
+        against *this* engine's completed ladders, budgets on-ladder.
+        ``strict`` (the default, and the ``policy_table_path`` route)
+        raises :class:`~..analysis.graftplan.PolicyTableError` on any
+        finding; ``strict=False`` installs anyway and flips the
+        ``policy_table_stale`` gauge (certification harness / expert
+        seam). Returns the findings list."""
+        import json as _json
+
+        from neuronx_distributed_llama3_2_tpu.analysis.graftplan import (
+            PolicyTableError,
+            check_policy_table,
+        )
+
+        if isinstance(source, (str, bytes)):
+            with open(source) as fh:
+                table = _json.load(fh)
+        else:
+            table = dict(source)
+        findings = check_policy_table(
+            table,
+            prefill_buckets=self._prefill_buckets,
+            kv_buckets=self._kv_buckets,
+        )
+        if findings and strict:
+            raise PolicyTableError(findings)
+        apply = getattr(self.policy, "apply", None)
+        if apply is None:
+            raise ValueError(
+                f"step policy {type(self.policy).__name__} cannot load a "
+                'policy table; construct the engine with '
+                'PagedConfig(step_policy="table")'
+            )
+        apply(table)
+        self.metrics.policy_table_id = str(table.get("table_id", ""))[:12]
+        self.metrics.policy_table_stale = 1 if findings else 0
+        burn = (table.get("objective") or {}).get(
+            "simulated_burn_by_class"
+        ) or {}
+        self.metrics.policy_simulated_burn = {
+            str(cls): dict(v) for cls, v in burn.items()
+        }
+        return findings
 
     def _reorder_queue(self, order: Sequence[int]) -> None:
         """Reorder the waiting queue to match ``order`` (a ranking of rids

@@ -38,6 +38,8 @@ _GAUGE_FIELDS = frozenset({
     "slo_burn_ttft", "slo_burn_tpot",
     # graftserve front-door gauges (rewritten every step / stream event)
     "queued_requests", "active_streams",
+    # graftplan policy-table gauges (set once at table load)
+    "policy_table_stale",
 })
 
 # snapshot key -> hist_* field name (the stable public names dashboards
@@ -147,6 +149,14 @@ class ServingMetrics:
         default_factory=dict)  # class -> {submitted, finished, failed}
     slo_burn_by_class: Dict[str, dict] = dataclasses.field(
         default_factory=dict)  # class -> {"ttft": burn, "tpot": burn}
+    # -- graftplan policy table (analysis/graftplan.py; set by the
+    #    engine's table loader). The id is an info label like kv_dtype
+    #    (string; prometheus() skips non-numerics), stale flips to 1
+    #    when a table was loaded non-strictly with GC011 findings --
+    policy_table_id: str = ""      # table_id prefix of the loaded table
+    policy_table_stale: int = 0    # 1 = loaded with stale GC011 findings
+    policy_simulated_burn: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)  # class -> simulated burn from the artifact
     # -- fault tolerance (docs/serving.md "Failure handling & degradation") --
     faults_injected: int = 0       # chaos events fired by the FaultInjector
     failed_requests: int = 0       # requests ended in terminal `failed`
@@ -326,6 +336,10 @@ class ServingMetrics:
         rec["slo_burn_by_class"] = {
             cls: dict(v) for cls, v in sorted(self.slo_burn_by_class.items())
         }
+        rec["policy_simulated_burn"] = {
+            cls: dict(v)
+            for cls, v in sorted(self.policy_simulated_burn.items())
+        }
         rec["pad_waste_frac"] = self.pad_waste_frac()
         rec["decode_pad_frac"] = self._pad_frac(
             self.decode_pad_tokens, self.decode_need_tokens)
@@ -417,6 +431,22 @@ class ServingMetrics:
                 lines.append(
                     f'serving_slo_burn_class{{class="{cls}",'
                     f'objective="{objective}"}} {sbc[cls][objective]:g}')
+        # graftplan policy table: the id is a string, so it exports as an
+        # info label (kv_dtype precedent); the simulated per-class burns
+        # the artifact promises export as a labelled gauge family next to
+        # the observed serving_slo_burn_class series
+        if self.policy_table_id:
+            lines.append(
+                f'serving_policy_table_info'
+                f'{{table_id="{self.policy_table_id}"}} 1')
+        psb = snap.get("policy_simulated_burn") or {}
+        if psb:
+            lines.append("# TYPE serving_policy_simulated_burn_class gauge")
+        for cls in sorted(psb):
+            for objective in sorted(psb[cls]):
+                lines.append(
+                    f'serving_policy_simulated_burn_class{{class="{cls}",'
+                    f'objective="{objective}"}} {psb[cls][objective]:g}')
         roofs = snap.get("mfu_by_rung") or {}
         if roofs:
             lines.append("# TYPE serving_roofline_mfu_rung gauge")

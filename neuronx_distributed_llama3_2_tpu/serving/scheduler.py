@@ -1,10 +1,13 @@
-"""SLO-aware step scheduler for the paged engine (graftserve).
+"""SLO-aware step schedulers for the paged engine (graftserve).
 
-:class:`SloPolicy` is the first non-FIFO :class:`~.policy.StepPolicy`
-(ROADMAP item 2): it keeps the FIFO schedule *shape* — the exact arm
-structure the GC010 legality automaton was built against — and moves all
-of its scheduling authority into the two pieces of ``StepAction`` meta
-the engine honors:
+Two non-FIFO :class:`~.policy.StepPolicy` implementations live here —
+:class:`SloPolicy` (hand-tuned heuristics over live burn gauges, ROADMAP
+item 2) and :class:`TablePolicy` (constants synthesized offline by
+analysis/graftplan.py and loaded from a certified policy-table
+artifact). Both keep the FIFO schedule *shape* — the exact arm structure
+the GC010 legality automaton was built against — and move all of their
+scheduling authority into the two pieces of ``StepAction`` meta the
+engine honors:
 
 - ``ADMIT meta["admit_order"]``: a ranking of the waiting queue. The
   admission wave itself is unchanged (strict head-of-line over the
@@ -65,6 +68,47 @@ CLASS_RANK: Dict[str, int] = {"interactive": 0, "batch": 1}
 BURN_BOOST = 2
 
 
+def rank_queue(
+    queued: List[QueuedRequest],
+    rank_fn,
+    tenant_weights: Optional[Mapping[str, float]] = None,
+) -> List[int]:
+    """THE admission-ranking kernel, shared by :class:`SloPolicy`,
+    :class:`TablePolicy` and the graftplan simulator (the calibration
+    test pins one implementation, not two): priority tiers from
+    ``rank_fn(service_class)`` (lower admits earlier), weighted
+    round-robin across tenants inside a tier (stride scheduling —
+    each pick charges the tenant 1/weight), FCFS within a tenant.
+    Deterministic: ties break on tenant name then queue position,
+    never on iteration order."""
+    weights = dict(tenant_weights or {})
+
+    def weight(tenant: str) -> float:
+        w = weights.get(tenant, 1.0)
+        return w if w > 0 else 1.0
+
+    tiers: Dict[float, Dict[str, List[QueuedRequest]]] = {}
+    for q in queued:
+        tiers.setdefault(rank_fn(q.service_class), {}) \
+            .setdefault(q.tenant, []).append(q)
+    order: List[int] = []
+    for rank in sorted(tiers):
+        by_tenant = tiers[rank]
+        for reqs in by_tenant.values():
+            reqs.sort(key=lambda q: q.position)  # FCFS within tenant
+        credit = {t: 0.0 for t in by_tenant}
+        while by_tenant:
+            tenant = min(
+                by_tenant,
+                key=lambda t: (credit[t] / weight(t), t),
+            )
+            order.append(by_tenant[tenant].pop(0).rid)
+            credit[tenant] += 1.0
+            if not by_tenant[tenant]:
+                del by_tenant[tenant]
+    return order
+
+
 @register_policy
 class SloPolicy(StepPolicy):
     """SLO-aware scheduling over the policy seam (see module docstring).
@@ -96,6 +140,22 @@ class SloPolicy(StepPolicy):
         self.pad_waste_ceiling = float(pad_waste_ceiling)
         self._logged_catalog = False
 
+    @classmethod
+    def from_table(cls, source) -> "TablePolicy":
+        """Build a table-driven policy from a graftplan policy-table
+        artifact (path or dict). The table is GC011-checked against its
+        own certificate and automaton fingerprint here; the engine
+        re-checks ladder freshness against its live catalog when the
+        policy is installed (``PagedConfig.policy_table_path`` or
+        ``load_policy_table``)."""
+        from neuronx_distributed_llama3_2_tpu.analysis.graftplan import (
+            load_policy_table,
+        )
+
+        policy = TablePolicy()
+        policy.apply(load_policy_table(source))
+        return policy
+
     def reset(self) -> None:
         self._spec_pause = 0
         self._logged_catalog = False
@@ -116,38 +176,15 @@ class SloPolicy(StepPolicy):
         return rank
 
     def _admit_order(self, view: EngineView) -> List[int]:
-        """Rank the waiting queue: priority tiers (class rank with burn
-        boost), weighted round-robin across tenants inside a tier, FCFS
-        inside a tenant. Deterministic — ties break on tenant name then
-        queue position, never on iteration order."""
-        queued = view.queued()
+        """Rank the waiting queue through :func:`rank_queue`: priority
+        tiers (class rank with burn boost), weighted round-robin across
+        tenants inside a tier, FCFS inside a tenant."""
         burning = self._burning_classes(view)
-        tiers: Dict[int, Dict[str, List[QueuedRequest]]] = {}
-        for q in queued:
-            tiers.setdefault(self._rank(q.service_class, burning), {}) \
-                .setdefault(q.tenant, []).append(q)
-        order: List[int] = []
-        for rank in sorted(tiers):
-            by_tenant = tiers[rank]
-            for reqs in by_tenant.values():
-                reqs.sort(key=lambda q: q.position)  # FCFS within tenant
-            # stride scheduling: each pick charges the tenant 1/weight;
-            # the cheapest accumulated pass (then tenant name) goes next
-            credit = {t: 0.0 for t in by_tenant}
-            while by_tenant:
-                tenant = min(
-                    by_tenant,
-                    key=lambda t: (credit[t] / self._weight(t), t),
-                )
-                order.append(by_tenant[tenant].pop(0).rid)
-                credit[tenant] += 1.0
-                if not by_tenant[tenant]:
-                    del by_tenant[tenant]
-        return order
-
-    def _weight(self, tenant: str) -> float:
-        w = self.tenant_weights.get(tenant, 1.0)
-        return w if w > 0 else 1.0
+        return rank_queue(
+            view.queued(),
+            lambda cls: self._rank(cls, burning),
+            tenant_weights=self.tenant_weights,
+        )
 
     def _admit_meta(self, view: EngineView) -> dict:
         # ranking a queue the wave cannot admit from is wasted O(n log n)
@@ -216,6 +253,117 @@ class SloPolicy(StepPolicy):
         if self._spec_pause > 0:
             self._spec_pause -= 1
         if async_on and view.async_eligible:
+            yield StepAction(ActionType.DECODE_DISPATCH, mode="async")
+            if not view.last_async_fell_back:
+                return
+        yield StepAction(ActionType.READBACK)
+        yield StepAction(ActionType.ADMIT, meta=self._admit_meta(view))
+        yield StepAction(
+            ActionType.PREFILL_CHUNK, meta=self._prefill_meta(view)
+        )
+        yield StepAction(ActionType.DECODE_DISPATCH, mode="sync")
+
+
+@register_policy
+class TablePolicy(SloPolicy):
+    """Policy driven by a graftplan-synthesized table
+    (``step_policy="table"``; analysis/graftplan.py, docs/serving.md
+    "Policy tables").
+
+    Where :class:`SloPolicy` computes its admission ranks and prefill
+    budgets from hand-tuned heuristics over live gauges, TablePolicy
+    reads them from a certified offline artifact: per-class admission
+    weights and burn boost, a prefill chunk budget per burn state
+    (quantized to the catalog's prefill ladder), a verify cadence, and
+    the sync/async preference. The arm *structure* stays action-for-
+    action the FIFO shape, so every schedule is GC010-legal by the same
+    argument — and the table's certificate proves the explorer checked
+    it anyway.
+
+    Without a table applied, every override falls back to the plain
+    SloPolicy behavior (``make_policy("table")`` must construct without
+    arguments; the engine applies the artifact right after, enforced by
+    GC011 at load time)."""
+
+    name = "table"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.table: Optional[dict] = None
+        self._vec = None
+        self._step_no = 0
+
+    def reset(self) -> None:
+        super().reset()
+        self._step_no = 0
+
+    def apply(self, table: Mapping) -> None:
+        """Install a (parsed) policy-table artifact. Callers wanting the
+        GC011 checks go through :meth:`SloPolicy.from_table` or the
+        engine's loader — ``apply`` itself trusts its input so the
+        certification harness can run a not-yet-stamped candidate."""
+        from neuronx_distributed_llama3_2_tpu.analysis.graftplan import (
+            PolicyVector,
+        )
+
+        self.table = dict(table)
+        self._vec = PolicyVector.from_dict(self.table.get("vector", {}))
+        slo = self.table.get("slo", {})
+        self.tenant_weights = dict(slo.get("tenant_weights", {}))
+        self.burn_threshold = float(slo.get("burn_threshold", 1.0))
+
+    @property
+    def table_id(self) -> str:
+        return str(self.table.get("table_id", "")) if self.table else ""
+
+    def _rank(self, cls: str, burning: frozenset):
+        if self._vec is None:
+            return super()._rank(cls, burning)
+        return self._vec.rank(cls, cls in burning)
+
+    def _prefill_budget(self, view: EngineView) -> Optional[int]:
+        if self._vec is None:
+            return super()._prefill_budget(view)
+        ttft_burn, tpot_burn = view.slo_burn
+        if ttft_burn >= self.burn_threshold:
+            state = "ttft_burn"
+        elif tpot_burn >= self.burn_threshold:
+            state = "tpot_burn"
+        else:
+            state = "calm"
+        return self._vec.budget_for(state)
+
+    def actions(self, view: EngineView) -> Iterator[StepAction]:
+        if self._vec is None:
+            yield from super().actions(view)
+            return
+        # the SloPolicy/Fifo arm structure with the table's two choice
+        # points: a VERIFY arm only every `verify_cadence` steps, and
+        # the async lookahead only when the table prefers it
+        self._step_no += 1
+        cfg = view.config
+        spec_on = view.spec_enabled and view.degrade_level < 1
+        async_on = cfg.async_loop and view.degrade_level < 2
+        cadence = max(int(self._vec.verify_cadence), 1)
+        if (
+            spec_on
+            and self._spec_pause <= 0
+            and self._step_no % cadence == 0
+        ):
+            yield StepAction(ActionType.READBACK)
+            yield StepAction(ActionType.ADMIT, meta=self._admit_meta(view))
+            yield StepAction(
+                ActionType.PREFILL_CHUNK, meta=self._prefill_meta(view)
+            )
+            yield StepAction(ActionType.VERIFY)
+            if not view.last_verify_drafted:
+                if async_on:
+                    self._spec_pause = cfg.spec_retry_steps
+                yield StepAction(ActionType.DECODE_DISPATCH, mode="sync")
+            return
+        if self._spec_pause > 0:
+            self._spec_pause -= 1
+        if async_on and self._vec.prefer_async and view.async_eligible:
             yield StepAction(ActionType.DECODE_DISPATCH, mode="async")
             if not view.last_async_fell_back:
                 return

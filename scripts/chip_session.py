@@ -99,9 +99,14 @@ STAGES = [
     # graftserve load: 10k+ mixed-class requests through the fifo-vs-slo
     # comparison legs plus concurrent asyncio streaming clients, gated on
     # interactive p99 TTFT improving under SloPolicy at <=5% tokens/step
-    # cost (scripts/serving_load.py; --smoke leg runs in tier-1)
+    # cost (scripts/serving_load.py; --smoke leg runs in tier-1).
+    # --policy-table auto adds the graftplan leg: synthesize + certify a
+    # policy table from the recorded FIFO leg (banked to
+    # SERVING_TRACE_DIR), then run the full 10k-request workload under
+    # the certified TablePolicy against the same A/B gates
     ("serving_load",
-     [PY, os.path.join(REPO, "scripts", "serving_load.py")], 1200),
+     [PY, os.path.join(REPO, "scripts", "serving_load.py"),
+      "--policy-table", "auto"], 1800),
     ("churn_1b",
      [PY, os.path.join(REPO, "scripts", "infer_bench_stage.py"),
       "--stage", "churn", "--model", "llama3.2-1b"], 900),

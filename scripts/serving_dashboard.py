@@ -165,6 +165,28 @@ def render_snapshot(snap: dict) -> str:
             f"  burn/{cls:<9} ttft {t:>7.3f} [{_bar(t)}]  "
             f"tpot {p:>7.3f} [{_bar(p)}]"
         )
+    # graftplan policy panel (docs/static_analysis.md "graftplan"): the
+    # loaded certified table's id, simulated (from the artifact) vs
+    # observed (live SLO monitor) burn per class, and a warning when the
+    # table was force-loaded past stale GC011 findings
+    if g("policy_table_id"):
+        lines.append(f"policy     table {g('policy_table_id')}")
+        psb = g("policy_simulated_burn") or {}
+        for cls in sorted(psb):
+            sim = psb[cls]
+            obs = sbc.get(cls) or {}
+            lines.append(
+                f"  plan/{cls:<9} ttft "
+                f"sim {float(sim.get('ttft', 0.0) or 0.0):>7.3f} "
+                f"obs {float(obs.get('ttft', 0.0) or 0.0):>7.3f}  tpot "
+                f"sim {float(sim.get('tpot', 0.0) or 0.0):>7.3f} "
+                f"obs {float(obs.get('tpot', 0.0) or 0.0):>7.3f}"
+            )
+        if g("policy_table_stale"):
+            lines.append(
+                "  WARNING: stale certificate (GC011) — re-synthesize "
+                "via scripts/graftplan_gate.py --write-table"
+            )
     return "\n".join(lines)
 
 
@@ -208,6 +230,12 @@ def parse_prometheus(text: str) -> dict:
                 kind = "decode" if name.startswith("serving_decode") else "prefill"
                 flat.setdefault(f"{kind}_pad_by_rung", {}) \
                     .setdefault(int(labels["rung"]), {})["pad_frac"] = \
+                    float(val)
+            elif name == "serving_policy_table_info":
+                flat["policy_table_id"] = labels.get("table_id", "")
+            elif name == "serving_policy_simulated_burn_class":
+                flat.setdefault("policy_simulated_burn", {}) \
+                    .setdefault(labels["class"], {})[labels["objective"]] = \
                     float(val)
             elif name == "serving_roofline_mfu_rung":
                 flat.setdefault("mfu_by_rung", {}) \
@@ -308,6 +336,9 @@ def _demo() -> int:
         PagedConfig(
             block_size=8, num_blocks=32, async_loop=True,
             trace_enabled=True,
+            # graftplan demo coverage: a TablePolicy engine so the
+            # policy panel renders (the demo table loads below)
+            step_policy="table",
             # graftmeter demo coverage: SLO burn gauges render on the
             # dashboard (loose targets, so the demo stays alert-free)
             slo_ttft_p99_ms=60_000.0, slo_tpot_p99_ms=60_000.0,
@@ -317,6 +348,37 @@ def _demo() -> int:
     # the demo engine warms lazily (no prewarm), so harvest explicitly to
     # light up the capacity/MFU panels
     paged.ensure_cost_profiles()
+    # graftplan policy panel demo: an uncertified hand-built table on the
+    # demo engine's own ladders, force-loaded past GC011 — the panel
+    # renders with simulated-vs-observed burn AND the stale-certificate
+    # warning line (the honest rendering of a table nothing certified)
+    from neuronx_distributed_llama3_2_tpu.analysis.graftplan import (
+        _stamp,
+        automaton_fingerprint,
+        ladder_fingerprint,
+    )
+
+    demo_table = _stamp({
+        "version": 1,
+        "generator": "serving_dashboard --demo",
+        "ladder": {
+            "prefill": list(paged._prefill_buckets),
+            "kv": list(paged._kv_buckets),
+        },
+        "fingerprints": {
+            "automaton": automaton_fingerprint(),
+            "ladder": ladder_fingerprint(
+                paged._prefill_buckets, paged._kv_buckets
+            ),
+            "trace": "0" * 40,
+        },
+        "vector": {"class_weight": {"interactive": 0.0, "batch": 1.0}},
+        "objective": {"simulated_burn_by_class": {
+            "batch": {"ttft": 0.0, "tpot": 0.0},
+            "interactive": {"ttft": 0.02, "tpot": 0.0},
+        }},
+    })
+    paged.load_policy_table(demo_table, strict=False)
     rng = __import__("numpy").random.default_rng(0)
     for i, n in enumerate((5, 11, 7, 19)):
         paged.submit(

@@ -4,9 +4,11 @@
 Two legs, both seeded and CPU-hosted on the tiny model:
 
 1. **Policy comparison** — the same mixed-class/mixed-tenant workload is
-   burst- (smoke) or wave- (full) submitted into two otherwise identical
-   engines, one under ``FifoPolicy`` and one under ``SloPolicy``, and the
-   run is gated on the graftscope histograms the engines observe into:
+   burst- (smoke) or wave- (full) submitted into otherwise identical
+   engines, one under ``FifoPolicy`` and one under ``SloPolicy`` (and,
+   with ``--policy-table``, a third under a certified graftplan
+   ``TablePolicy``), and the run is gated on the graftscope histograms
+   the engines observe into:
 
    - every request finishes (zero failed/stuck), the action trace is
      GC010-clean, ``audit_engine`` and ``leak_check`` are clean;
@@ -25,6 +27,7 @@ Usage:
     python scripts/serving_load.py            # full: 10k+ requests
     python scripts/serving_load.py --smoke    # tier-1: small, seconds
     python scripts/serving_load.py --requests 2000 --seed 3
+    python scripts/serving_load.py --policy-table auto   # + table leg
 
 ``--smoke`` is what ``tests/test_server.py`` runs in-process; the full
 run is staged in ``scripts/chip_session.py``.
@@ -90,7 +93,8 @@ def make_engine_factory():
         _STATE = (cfg, params)
     cfg, params = _STATE
 
-    def factory(policy_name: str) -> PagedServingEngine:
+    def factory(policy_name: str, table_path=None,
+                policy=None) -> PagedServingEngine:
         return PagedServingEngine(
             InferenceEngine(
                 cfg, params, max_batch=4, max_seq_len=64,
@@ -100,6 +104,9 @@ def make_engine_factory():
             PagedConfig(
                 block_size=8, num_blocks=64, prefill_chunk_tokens=8,
                 async_loop=True, step_policy=policy_name,
+                # graftplan: a certified table artifact for the "table"
+                # leg, loaded at construction under GC011
+                policy_table_path=table_path,
                 # tight TTFT objective (burns under the burst, exercising
                 # the burn-feedback path) but a loose TPOT one: a burning
                 # TPOT clamps SloPolicy's prefill budget, which is decode
@@ -107,6 +114,7 @@ def make_engine_factory():
                 slo_ttft_p99_ms=50.0, slo_tpot_p99_ms=10_000.0,
                 slo_eval_steps=8,
             ),
+            policy=policy,
             precompile=False,
         )
 
@@ -157,12 +165,13 @@ def _audit_clean(eng, label: str) -> int:
     return rc
 
 
-def run_policy_leg(factory, policy_name: str, workload, wave: int = 0):
+def run_policy_leg(factory, policy_name: str, workload, wave: int = 0,
+                   table_path=None):
     """Run one engine under ``policy_name`` over the workload. ``wave``
     > 0 paces submissions (that many per step — open-loop arrivals, so
     the queue stays bounded on 10k-request runs); 0 bursts everything
     up front (smoke: maximal head-of-line pressure)."""
-    eng = factory(policy_name)
+    eng = factory(policy_name, table_path)
     todo = list(workload)
     if not wave:
         for prompt, sc, tenant in todo:
@@ -197,12 +206,14 @@ def run_policy_leg(factory, policy_name: str, workload, wave: int = 0):
     return eng, stats, rc
 
 
-def check_comparison(workload, fifo_stats, slo_stats) -> int:
-    """The fifo-vs-slo acceptance gates (see module docstring)."""
+def check_comparison(workload, fifo_stats, cand_stats,
+                     label: str = "slo") -> int:
+    """The fifo-vs-candidate acceptance gates (see module docstring):
+    the same bar for SloPolicy and for a graftplan TablePolicy leg."""
     rc = 0
     n_int = sum(1 for _, sc, _ in workload if sc == "interactive")
     n_bat = len(workload) - n_int
-    for name, stats in (("fifo", fifo_stats), ("slo", slo_stats)):
+    for name, stats in (("fifo", fifo_stats), (label, cand_stats)):
         if stats["failed"] or stats["finished"] != len(workload):
             print(
                 f"serving_load: GATE: {name} finished={stats['finished']} "
@@ -219,25 +230,69 @@ def check_comparison(workload, fifo_stats, slo_stats) -> int:
             )
             rc = 1
     fifo_p99 = fifo_stats["ttft_by_class"]["interactive"]["p99"]
-    slo_p99 = slo_stats["ttft_by_class"]["interactive"]["p99"]
-    if not slo_p99 < fifo_p99:
+    cand_p99 = cand_stats["ttft_by_class"]["interactive"]["p99"]
+    if not cand_p99 < fifo_p99:
         print(
             f"serving_load: GATE: interactive p99 TTFT did not improve: "
-            f"slo {slo_p99}ms vs fifo {fifo_p99}ms"
+            f"{label} {cand_p99}ms vs fifo {fifo_p99}ms"
         )
         rc = 1
-    tps_f, tps_s = fifo_stats["tokens_per_step"], slo_stats["tokens_per_step"]
-    if tps_f and tps_s < 0.95 * tps_f:
+    tps_f, tps_c = fifo_stats["tokens_per_step"], cand_stats["tokens_per_step"]
+    if tps_f and tps_c < 0.95 * tps_f:
         print(
             f"serving_load: GATE: tokens/step regressed >5%: "
-            f"slo {tps_s:.3f} vs fifo {tps_f:.3f}"
+            f"{label} {tps_c:.3f} vs fifo {tps_f:.3f}"
         )
         rc = 1
     print(
         f"serving_load: interactive p99 TTFT {fifo_p99:.1f}ms (fifo) -> "
-        f"{slo_p99:.1f}ms (slo); tokens/step {tps_f:.3f} -> {tps_s:.3f}"
+        f"{cand_p99:.1f}ms ({label}); tokens/step {tps_f:.3f} -> {tps_c:.3f}"
     )
     return rc
+
+
+def synthesize_policy_table(fifo_eng, factory, workload, out_path,
+                            seed: int = 0) -> str:
+    """``--policy-table auto``: the full offline graftplan workflow on
+    THIS harness's engine geometry — record (the drained FIFO leg),
+    synthesize over a bounded window of the recorded spans, certify
+    live on a small replay engine, write the artifact. A table
+    synthesized elsewhere (e.g. the gate's golden, built on a different
+    bucket ladder) would be rejected under GC011 at load, so the staged
+    10k-request leg must carry its own certified table."""
+    import json
+
+    from neuronx_distributed_llama3_2_tpu.analysis import graftplan
+
+    rec = fifo_eng.export_workload()
+    # the search cost is per-simulated-request; a 256-span window keeps
+    # synthesis seconds even on the 10k run while preserving class mix
+    rec.requests = rec.requests[:256]
+    rec.trace = {
+        k: rec.trace[k] for k in ("steps", "actions") if k in rec.trace
+    }
+    synth = graftplan.synthesize(rec, seed=seed)
+    table = graftplan.build_table(rec, synth)
+
+    cert_requests = list(workload)[:12]
+
+    def cert_factory(policy):
+        eng = factory("fifo", None, policy)
+        for prompt, sc, tenant in cert_requests:
+            eng.submit(prompt, service_class=sc, tenant=tenant)
+        return eng
+
+    table = graftplan.certify_table(table, cert_factory, max_steps=400)
+    with open(out_path, "w") as fh:
+        json.dump(table, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    cert = table["certificate"]
+    print(
+        f"serving_load: policy table {table['table_id'][:12]} "
+        f"({100 * synth.improvement:+.2f}% simulated, gc010_clean="
+        f"{cert['gc010_clean']}) -> {out_path}"
+    )
+    return out_path
 
 
 async def run_async_leg(factory, n_clients: int, seed: int) -> int:
@@ -325,6 +380,12 @@ def main(argv=None) -> int:
         "--clients", type=int, default=None,
         help="async streaming clients (default requests//10, min 12)",
     )
+    ap.add_argument(
+        "--policy-table", default=None, metavar="PATH",
+        help="run a third comparison leg under a certified graftplan "
+        "policy table (step_policy='table'); 'auto' synthesizes + "
+        "certifies one from the FIFO leg's recorded workload first",
+    )
     args = ap.parse_args(argv)
 
     total = args.requests or (32 if args.smoke else 10_000)
@@ -336,10 +397,32 @@ def main(argv=None) -> int:
     factory = make_engine_factory()
     workload = make_workload(args.seed, n_interactive, n_batch)
     rc = 0
-    _, fifo_stats, rc_f = run_policy_leg(factory, "fifo", workload, wave)
+    fifo_eng, fifo_stats, rc_f = run_policy_leg(
+        factory, "fifo", workload, wave
+    )
     _, slo_stats, rc_s = run_policy_leg(factory, "slo", workload, wave)
     rc |= rc_f | rc_s
     rc |= check_comparison(workload, fifo_stats, slo_stats)
+    if args.policy_table:
+        if args.policy_table == "auto":
+            out_dir = os.environ.get("SERVING_TRACE_DIR")
+            if out_dir:
+                os.makedirs(out_dir, exist_ok=True)
+            else:
+                import tempfile
+
+                out_dir = tempfile.mkdtemp(prefix="graftplan_")
+            table_path = synthesize_policy_table(
+                fifo_eng, factory, workload,
+                os.path.join(out_dir, "policy_table.json"), seed=args.seed,
+            )
+        else:
+            table_path = args.policy_table
+        _, tab_stats, rc_t = run_policy_leg(
+            factory, "table", workload, wave, table_path=table_path
+        )
+        rc |= rc_t
+        rc |= check_comparison(workload, fifo_stats, tab_stats, label="table")
     rc |= asyncio.run(run_async_leg(factory, clients, args.seed))
     print(f"serving_load: {'FAIL' if rc else 'clean'} "
           f"({total} requests, {clients} async clients)")

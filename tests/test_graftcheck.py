@@ -409,7 +409,7 @@ def test_fingerprint_is_stable_and_detail_keyed():
 def test_rule_catalogue_complete():
     assert sorted(gc.GC_RULES) == [
         "GC001", "GC002", "GC003", "GC004", "GC005", "GC006",
-        "GC007", "GC008", "GC009", "GC010",
+        "GC007", "GC008", "GC009", "GC010", "GC011",
     ]
 
 

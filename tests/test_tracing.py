@@ -240,6 +240,8 @@ EXPECTED_SNAPSHOT_KEYS = {
     # graftserve: front-door gauges + per-class lifecycle/burn tables
     "queued_requests", "active_streams", "cancelled_requests",
     "requests_by_class", "slo_burn_by_class",
+    # graftplan: certified policy-table gauges
+    "policy_table_id", "policy_table_stale", "policy_simulated_burn",
     # derived
     "prefix_skip_fraction", "accept_rate", "host_schedule_ms_per_step",
     "device_wait_ms_per_step",
