@@ -809,8 +809,11 @@ def audit_programs(
                     ),
                 )
             )
-        if rec.kind in ("pdecode", "pverify") and not rec.gather:
-            t = 1 + int(rec.meta.get("k", 0))
+        if rec.kind in ("pdecode", "pverify", "pmixed") and not rec.gather:
+            if rec.kind == "pmixed":
+                t = int(rec.meta.get("t", 1))
+            else:
+                t = 1 + int(rec.meta.get("k", 0))
             if engine.model._paged_kernel_eligible(t, None):
                 forbidden = engine.model.forbidden_gather_shapes(
                     engine.engine.max_batch, int(rec.meta["kv_limit"])

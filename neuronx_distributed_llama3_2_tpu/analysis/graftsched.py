@@ -115,6 +115,8 @@ AUTOMATON: Tuple[Dict[str, str], ...] = (
          effect="outstanding += 1"),
     dict(action="VERIFY", guard="outstanding == 0; lanes not freed",
          effect="- (same-step readback)"),
+    dict(action="MIXED_DISPATCH", guard="outstanding == 0; lanes not freed",
+         effect="- (same-step readback)"),
     dict(action="READBACK", guard="outstanding >= 1; lag <= 1",
          effect="outstanding -= 1"),
     dict(action="LANE_SET_FLUSH", guard="outstanding == 0", effect="-"),
@@ -130,6 +132,11 @@ _HINTS = {
     "verify-in-flight": (
         "verify needs same-step readback; drain the lookahead (READBACK) "
         "before scheduling VERIFY"
+    ),
+    "mixed-in-flight": (
+        "the fused mixed-mode step reads back in the same step and its "
+        "prefill rows rewrite live KV rows; drain the lookahead "
+        "(READBACK) before scheduling MIXED_DISPATCH"
     ),
     "lane-set-in-flight": (
         "full-lane syncs donate all residents; only flush dirty lanes at "
@@ -224,6 +231,20 @@ def advance(state: ScheduleState, act: StepAction, where: str) -> List[Finding]:
             v.append(_finding(
                 "freed-lane", where,
                 f"verify dispatch into freed lane(s) {hit}",
+                detail=f"lanes={hit}",
+            ))
+    elif t is ActionType.MIXED_DISPATCH:
+        if state.outstanding:
+            v.append(_finding(
+                "mixed-in-flight", where,
+                f"MIXED_DISPATCH with {state.outstanding} step(s) in flight",
+            ))
+        packed = set(lanes) | set(meta.get("prefill_lanes") or [])
+        hit = sorted(packed & state.freed)
+        if hit:
+            v.append(_finding(
+                "freed-lane", where,
+                f"mixed dispatch into freed lane(s) {hit}",
                 detail=f"lanes={hit}",
             ))
     elif t is ActionType.READBACK:

@@ -101,13 +101,14 @@ HOST_MIRRORS = frozenset({
 RESIDENT_WRITERS = frozenset({
     "__init__", "prewarm", "_flush_state",
     "_step_async", "_dispatch_sync_decode", "_verify_phase",
+    "_mixed_phase",
 })
 #: methods allowed to write host mirror rows (all of them either mark the
 #: lane dirty for _flush_state or are the post-readback commit itself).
 MIRROR_WRITERS = frozenset({
     "__init__", "_admit_wave", "_advance_prefills", "_append_block",
     "_read_and_apply", "_release_lane", "_dispatch_sync_decode",
-    "_step_async", "_verify_phase",
+    "_step_async", "_verify_phase", "_mixed_phase",
     "_install_lane_sampling", "_clear_lane_sampling",
 })
 

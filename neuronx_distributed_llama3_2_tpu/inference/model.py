@@ -238,6 +238,7 @@ class LlamaDecode:
         tree: Optional[Tuple[jax.Array, jax.Array]] = None,
         kv_limit: Optional[int] = None,
         block_tables: Optional[jax.Array] = None,  # (b, W) int32 pool block ids
+        row_live: Optional[jax.Array] = None,      # (b,) int32 live fresh rows
     ) -> Tuple[jax.Array, KVCache]:
         """Block-causal forward over the cache.
 
@@ -266,6 +267,15 @@ class LlamaDecode:
         pool row ``block_tables[i, p // bs] * bs + p % bs``. ``slots`` is
         ignored (the table IS the indirection). ``kv_limit`` bounds the
         *logical* rows gathered for attention, exactly as in the dense path.
+
+        ``row_live`` (paged kernel path only): per-lane count of *live*
+        fresh query rows in a mixed-width block — lane ``i``'s rows
+        ``>= row_live[i]`` are packing padding whose outputs the caller
+        discards, so the kernel stops its per-lane KV walk at
+        ``positions[i] + row_live[i] - 1`` instead of the static
+        ``positions[i] + t - 1`` frontier. Semantically inert (the
+        block-causal mask already governs every live row); ``None`` (the
+        default, static) leaves all existing lowerings bitwise unchanged.
         """
         c = self.config
         model = self._model()
@@ -310,7 +320,7 @@ class LlamaDecode:
             x, kc, vc = self._decode_layer(
                 lp, x, kc, vc, sin, cos, pos_block, positions, slots,
                 context_encode=context_encode, tree=tree, kv_limit=kv_limit,
-                block_tables=block_tables,
+                block_tables=block_tables, row_live=row_live,
             )
             return x, (kc, vc)
 
@@ -350,6 +360,7 @@ class LlamaDecode:
     def _decode_layer(
         self, lp, x, kc, vc, sin, cos, pos_block, positions, slots,
         *, context_encode: bool, tree=None, kv_limit=None, block_tables=None,
+        row_live=None,
     ):
         """One decoder layer with cache read/write.
 
@@ -381,7 +392,7 @@ class LlamaDecode:
         att, kc, vc = self._attend_with_cache(
             q, k, v, kc, vc, slots, pos_block, positions,
             context_encode=context_encode, tree=tree, kv_limit=kv_limit,
-            block_tables=block_tables,
+            block_tables=block_tables, row_live=row_live,
         )
         att = att.reshape(b, t, c.num_heads * c.head_dim)
         x = x + attn._o()(lp["attn"]["o"], att)
@@ -392,6 +403,7 @@ class LlamaDecode:
     def _attend_with_cache(
         self, q, k, v, kc, vc, slots, pos_block, positions,
         *, context_encode: bool, tree=None, kv_limit=None, block_tables=None,
+        row_live=None,
     ):
         """Cache write + attention, shared by every decode family (Llama,
         MoE, GPT-NeoX): scatter the fresh roped K/V into the cache, then
@@ -414,7 +426,7 @@ class LlamaDecode:
             return self._attend_paged(
                 q, k, v, kc, vc, block_tables, write_rows, pos_block,
                 positions, context_encode=context_encode, tree=tree,
-                kv_limit=kv_limit,
+                kv_limit=kv_limit, row_live=row_live,
             )
         if isinstance(kc, tuple):
             raise ValueError(
@@ -450,7 +462,7 @@ class LlamaDecode:
 
     def _attend_paged(
         self, q, k, v, kc, vc, block_tables, write_rows, pos_block, positions,
-        *, context_encode: bool, tree=None, kv_limit=None,
+        *, context_encode: bool, tree=None, kv_limit=None, row_live=None,
     ):
         """Paged cache write + attention: the block table translates logical
         sequence rows to pool rows for both the fresh-block scatter and the
@@ -550,12 +562,14 @@ class LlamaDecode:
                         mesh=parallel_state.get_parallel_state().mesh,
                         kv_limit=limit, k_scale=ksc, v_scale=vsc,
                         quant_mxu=c.quant_mxu and ksc is not None,
+                        row_live=row_live,
                     )
                 else:
                     att = paged_flash_decode(
                         q, kc, vc, block_tables, positions, kv_limit=limit,
                         k_scale=ksc, v_scale=vsc,
                         quant_mxu=c.quant_mxu and ksc is not None,
+                        row_live=row_live,
                     )
                 att = constrain(att, P(BATCH_AXES, None, ha, None))
             else:
@@ -761,6 +775,111 @@ class LlamaDecode:
             return emitted, accept, new_tokens, new_positions, finite, cache
         return emitted, accept, new_tokens, new_positions, cache
 
+    def mixed_step(
+        self,
+        params: Params,
+        cache: PagedKVCache,
+        tokens: jax.Array,        # (b,) int32 — resident decode token per lane
+        positions: jax.Array,     # (b,) int32 — resident write row per lane
+        block_tables: jax.Array,  # (b, W) int32
+        rows: jax.Array,          # (b, t) int32 — per-lane packed row payload
+        row_start: jax.Array,     # (b,) int32 — forced rows' first write row
+        row_len: jax.Array,       # (b,) int32 — live payload rows, <= t
+        forced: jax.Array,        # (b,) int32 — 1 = prefill-chunk lane
+        *,
+        kv_limit: Optional[int] = None,
+        pos_cap: Optional[int] = None,
+        logit_poison: Optional[jax.Array] = None,
+        sampling: Optional[tuple] = None,
+    ) -> Tuple[jax.Array, ...]:
+        """One fused mixed-mode step: decode lanes, speculative-verify rows
+        and active prefill-chunk suffixes share a single t-row block-causal
+        forward over the paged pool (``PagedConfig.fused_step`` — ROADMAP
+        item 5's one-dispatch steady state). Per lane, ``forced`` selects
+        the row role:
+
+        - ``forced == 0`` (decode/verify): the scored block is
+          ``[tokens[i], rows[i, :t-1]]`` at rows ``positions[i] ..`` —
+          ``rows`` carries the lane's drafts and ``row_len`` its draft
+          count, so ``row_len == 0`` is exactly a plain decode step and
+          ``row_len == k`` exactly :meth:`verify_step` at width ``k + 1``.
+        - ``forced == 1`` (prefill chunk): the block is the next
+          ``row_len`` prompt tokens written at rows ``row_start[i] ..``
+          over the lane's own table (the psfx chunk semantics), the accept
+          length is *forced* to ``row_len - 1``, and the emitted token at
+          that index is the sample keyed ``row_start + row_len`` — on the
+          final chunk, byte-identical to the suffix-prefill program's
+          first generated token, and the resident (token, position)
+          advance to exactly what the unfused ``lane_set`` install would
+          have uploaded.
+
+        Rows past a lane's live width (``row_len`` forced,
+        ``row_len + 1`` otherwise) are packing padding: their outputs are
+        garbage the accept clamp never selects, and their frontier writes
+        are rewritten by the next dispatch over the same rows before any
+        block-causal mask admits them (the same overwrite-frontier
+        argument as rejected verify rows). ``row_live`` rides into
+        :meth:`forward` so the paged kernel stops each lane's KV walk at
+        its live frontier instead of the packed width.
+
+        Returns the :meth:`verify_step` tuple — ``(emitted (b, t),
+        accept (b,), new_tokens (b,), new_positions (b,), [finite (b,)],
+        cache)`` — with ``new_positions = eff_pos + accept + 1`` (clamped
+        to ``pos_cap``), where ``eff_pos`` is ``row_start`` on forced
+        lanes and ``positions`` otherwise. ``sampling`` / ``logit_poison``
+        compose exactly as in :meth:`verify_step`.
+        """
+        from neuronx_distributed_llama3_2_tpu.inference.speculative import (
+            accept_rule,
+        )
+
+        t = rows.shape[1]
+        is_forced = forced > 0
+        eff_pos = jnp.where(is_forced, row_start, positions)
+        # decode/verify lanes score [resident token, drafts]; forced lanes
+        # score the chunk payload verbatim
+        block = jnp.where(
+            is_forced[:, None],
+            rows,
+            jnp.concatenate([tokens[:, None], rows[:, : t - 1]], axis=1),
+        )
+        live = jnp.where(is_forced, row_len, row_len + 1)
+        logits, cache = self.forward(
+            params, cache, block, eff_pos, None,
+            block_tables=block_tables, kv_limit=kv_limit, row_live=live,
+        )
+        finite = None
+        if logit_poison is not None:
+            logits, finite = self.finite_logit_check(logits, logit_poison)
+        if sampling is not None:
+            from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+                sample_lanes,
+            )
+
+            rng_data, temperature, top_k, top_p = sampling
+            index = eff_pos[:, None] + 1 + jnp.arange(t, dtype=jnp.int32)
+            targets = sample_lanes(
+                logits, rng_data, index, temperature, top_k, top_p
+            )
+        else:
+            targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # forced lanes carry draft_len 0, so accept_rule hands back
+        # emitted == targets untouched; their accept is then overridden to
+        # land on the chunk's last row (targets[row_len - 1] is the token
+        # keyed row_start + row_len — the psfx sample index)
+        dl = jnp.where(is_forced, 0, row_len)
+        raw_accept, emitted = accept_rule(block[:, 1:], targets, draft_len=dl)
+        accept = jnp.where(
+            is_forced, jnp.maximum(row_len - 1, 0), raw_accept
+        )
+        new_tokens = jnp.take_along_axis(emitted, accept[:, None], axis=1)[:, 0]
+        new_positions = eff_pos + accept + 1
+        if pos_cap is not None:
+            new_positions = jnp.minimum(new_positions, pos_cap)
+        if finite is not None:
+            return emitted, accept, new_tokens, new_positions, finite, cache
+        return emitted, accept, new_tokens, new_positions, cache
+
     def forbidden_gather_shapes(self, batch: int, kv_limit: int):
         """The aval shapes a kernel-path decode/verify trace must never
         contain: the materialized ``(b, kv_limit, NKV, D)`` gathered-KV
@@ -939,6 +1058,7 @@ class GPTNeoXDecode(LlamaDecode):
     def _decode_layer(
         self, lp, x, kc, vc, sin, cos, pos_block, positions, slots,
         *, context_encode: bool, tree=None, kv_limit=None, block_tables=None,
+        row_live=None,
     ):
         from neuronx_distributed_llama3_2_tpu.models.gptneox import (
             GPTNeoXAttention,
@@ -966,7 +1086,7 @@ class GPTNeoXDecode(LlamaDecode):
         att, kc, vc = self._attend_with_cache(
             q, k, v, kc, vc, slots, pos_block, positions,
             context_encode=context_encode, tree=tree, kv_limit=kv_limit,
-            block_tables=block_tables,
+            block_tables=block_tables, row_live=row_live,
         )
         att = att.reshape(b, t, c.num_heads * c.head_dim)
         attn_out = attn._o()(lp["attn"]["o"], att)

@@ -67,16 +67,27 @@ def _ceil_div(a: int, b: int) -> int:
 def _decode_kernel(
     tbl_ref,   # scalar prefetch: (b, W) int32 block table (SMEM)
     pos_ref,   # scalar prefetch: (b,) int32 first-fresh-query positions (SMEM)
-    q_ref,     # (t*G, D) — this lane/kv-head's t fresh query groups
-    k_ref,     # (bs, D) — one pool block, fetched through the table
-    v_ref,     # (bs, D)
-    *refs,     # [ks_ref, vs_ref (bs, 1) — quantized scale tiles,] then
+    *refs,     # [live_ref (b,) int32 per-lane live-row counts (SMEM, only
+    #            when has_live),] then
+    #            q_ref (t*G, D) — this lane/kv-head's t fresh query groups,
+    #            k_ref / v_ref (bs, D) — one pool block via the table,
+    #            [ks_ref, vs_ref (bs, 1) — quantized scale tiles,] then
     #            o_ref (t*G, D) f32 per-split UNNORMALIZED accumulator,
     #            m_ref / l_ref (t*G, 1) f32 per-split running max / denom,
     #            and the m/l/acc VMEM scratch
     bs: int, bps: int, nblk: int, t: int, g: int, sm_scale: float,
-    quantized: bool = False, quant_mxu: bool = False,
+    quantized: bool = False, quant_mxu: bool = False, has_live: bool = False,
 ):
+    if has_live:
+        # mixed-width tile (fused_step): lane i's rows >= live_ref[i] are
+        # packing padding — the per-lane KV walk stops at its live
+        # frontier instead of the static pos + t - 1
+        live_ref = refs[0]
+        refs = refs[1:]
+    else:
+        live_ref = None
+    q_ref, k_ref, v_ref = refs[:3]
+    refs = refs[3:]
     if quantized:
         # int8/fp8 pool: the block DMA moved low-bit payload + the block's
         # (bs, 1) scale column for this kv head; dequant here in VMEM with
@@ -99,8 +110,12 @@ def _decode_kernel(
     pos = pos_ref[i]
     # skip padding blocks past kv_limit and blocks entirely beyond the
     # lane's LAST fresh query (the frontier: rows pos..pos+t-1 were just
-    # written; earlier queries in the tile mask the deeper rows per-row)
-    run = (lb < nblk) & (lb * bs <= pos + t - 1)
+    # written; earlier queries in the tile mask the deeper rows per-row).
+    # With per-lane live counts the frontier tightens to the deepest LIVE
+    # query — dead rows attend whatever the live walk visits and their
+    # garbage output is discarded by the caller
+    frontier = t - 1 if live_ref is None else live_ref[i] - 1
+    run = (lb < nblk) & (lb * bs <= pos + frontier)
 
     @pl.when(run)
     def _compute():
@@ -207,6 +222,7 @@ def paged_flash_decode(
     k_scale: jax.Array | None = None,  # (num_blocks, bs, NKV) — quantized pool
     v_scale: jax.Array | None = None,
     quant_mxu: bool = False,
+    row_live: jax.Array | None = None,  # (b,) int32 live query rows per lane
 ) -> jax.Array:
     """Gather-free paged decode attention; returns q's shape in q.dtype.
 
@@ -227,6 +243,14 @@ def paged_flash_decode(
     the scale columns ride through the *same* table-dereferencing index map
     as the payload blocks — one extra tiny (bs, 1) DMA per block — and the
     kernel dequantizes in VMEM, so HBM traffic stays low-bit.
+
+    ``row_live`` marks a mixed-width tile (the serving engine's
+    ``fused_step`` packing): lane ``i``'s query rows ``>= row_live[i]``
+    are padding whose outputs the caller discards, and the lane's KV walk
+    stops at ``positions[i] + row_live[i] - 1`` instead of the static
+    ``positions[i] + t - 1``. It rides in as a third scalar-prefetch
+    operand; ``None`` (the default) lowers exactly the pre-existing
+    two-operand kernel, so unfused traces stay bitwise unchanged.
 
     ``quant_mxu`` (quantized pool only) keeps the q·k dot itself in low
     precision: int8 pools contract int8 × int8 operands accumulating in
@@ -262,17 +286,20 @@ def paged_flash_decode(
     qg = qg.reshape(b, nkv, t * g, d)
     grid = (b, nkv, splits, bps)
 
-    def q_idx(i, h, s, j, tbl, pos):
+    # index maps see every scalar-prefetch operand after the grid indices;
+    # *rest absorbs the optional row_live operand so one set of maps
+    # serves both lowerings
+    def q_idx(i, h, s, j, tbl, pos, *rest):
         return (i, h, 0, 0)
 
-    def kv_idx(i, h, s, j, tbl, pos):
+    def kv_idx(i, h, s, j, tbl, pos, *rest):
         # the gather-free read: the table entry IS the pool block index the
         # pipeline DMAs next; clamp covers split padding (those iterations
         # are predicated off in the kernel body)
         lb = jnp.minimum(s * bps + j, nblk - 1)
         return (tbl[i, lb], 0, h, 0)
 
-    def out_idx(i, h, s, j, tbl, pos):
+    def out_idx(i, h, s, j, tbl, pos, *rest):
         return (i, h, s, 0, 0)
 
     tg = t * g
@@ -287,6 +314,7 @@ def paged_flash_decode(
     kernel = functools.partial(
         _decode_kernel, bs=bs, bps=bps, nblk=nblk, t=t, g=g,
         sm_scale=sm_scale, quantized=quantized, quant_mxu=quant_mxu,
+        has_live=row_live is not None,
     )
     in_specs = [
         pl.BlockSpec((None, None, tg, d), q_idx),
@@ -308,8 +336,11 @@ def paged_flash_decode(
             pl.BlockSpec((None, bs, None, 1), kv_idx),
         ]
         operands += [k_scale[..., None], v_scale[..., None]]
+    prefetch = [block_tables.astype(jnp.int32), positions.astype(jnp.int32)]
+    if row_live is not None:
+        prefetch.append(row_live.astype(jnp.int32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(prefetch),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -339,7 +370,7 @@ def paged_flash_decode(
         ),
         interpret=_interpret() if interpret is None else interpret,
     )(
-        block_tables.astype(jnp.int32), positions.astype(jnp.int32),
+        *prefetch,
         *operands,
     )
 
@@ -371,6 +402,7 @@ def paged_flash_decode_tp(
     k_scale: jax.Array | None = None,  # (num_blocks, bs, NKV) — quantized pool
     v_scale: jax.Array | None = None,
     quant_mxu: bool = False,
+    row_live: jax.Array | None = None,  # (b,) int32 — REPLICATED per rank
 ) -> jax.Array:
     """:func:`paged_flash_decode` sharded over the tensor-parallel mesh.
 
@@ -426,37 +458,74 @@ def paged_flash_decode_tp(
             raise ValueError(
                 "quant_mxu needs a quantized pool (k_scale/v_scale)"
             )
-        def local(qs, ks, vs, tbl, pos):
+        if row_live is None:
+            def local(qs, ks, vs, tbl, pos):
+                return paged_flash_decode(
+                    qs, ks, vs, tbl, pos,
+                    kv_limit=kv_limit, num_splits=num_splits,
+                    interpret=interpret,
+                )
+
+            return compat.shard_map(
+                local, mesh,
+                in_specs=(q_spec, pool_spec, pool_spec, P(None, None), P(None)),
+                out_specs=q_spec,
+                check_vma=False,
+            )(q, k_pool, v_pool, block_tables, positions)
+
+        # mixed-width tile: the per-lane live counts replicate exactly
+        # like positions — still no in-region collective
+        def local_l(qs, ks, vs, tbl, pos, live):
             return paged_flash_decode(
-                qs, ks, vs, tbl, pos,
+                qs, ks, vs, tbl, pos, row_live=live,
                 kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
             )
 
         return compat.shard_map(
-            local, mesh,
-            in_specs=(q_spec, pool_spec, pool_spec, P(None, None), P(None)),
+            local_l, mesh,
+            in_specs=(
+                q_spec, pool_spec, pool_spec, P(None, None), P(None), P(None),
+            ),
             out_specs=q_spec,
             check_vma=False,
-        )(q, k_pool, v_pool, block_tables, positions)
+        )(q, k_pool, v_pool, block_tables, positions, row_live)
 
     # quantized pool: the (num_blocks, bs, NKV) scale arrays split the SAME
     # kv-head axis as the payload pools, so each rank dequantizes its own
     # head slice locally — still zero in-region collectives
     scale_spec = P(None, None, TP_AXIS)
 
-    def local_q(qs, ks, vs, kss, vss, tbl, pos):
+    if row_live is None:
+        def local_q(qs, ks, vs, kss, vss, tbl, pos):
+            return paged_flash_decode(
+                qs, ks, vs, tbl, pos, k_scale=kss, v_scale=vss,
+                kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
+                quant_mxu=quant_mxu,
+            )
+
+        return compat.shard_map(
+            local_q, mesh,
+            in_specs=(
+                q_spec, pool_spec, pool_spec, scale_spec, scale_spec,
+                P(None, None), P(None),
+            ),
+            out_specs=q_spec,
+            check_vma=False,
+        )(q, k_pool, v_pool, k_scale, v_scale, block_tables, positions)
+
+    def local_ql(qs, ks, vs, kss, vss, tbl, pos, live):
         return paged_flash_decode(
-            qs, ks, vs, tbl, pos, k_scale=kss, v_scale=vss,
+            qs, ks, vs, tbl, pos, k_scale=kss, v_scale=vss, row_live=live,
             kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
             quant_mxu=quant_mxu,
         )
 
     return compat.shard_map(
-        local_q, mesh,
+        local_ql, mesh,
         in_specs=(
             q_spec, pool_spec, pool_spec, scale_spec, scale_spec,
-            P(None, None), P(None),
+            P(None, None), P(None), P(None),
         ),
         out_specs=q_spec,
         check_vma=False,
-    )(q, k_pool, v_pool, k_scale, v_scale, block_tables, positions)
+    )(q, k_pool, v_pool, k_scale, v_scale, block_tables, positions, row_live)

@@ -41,7 +41,7 @@ from neuronx_distributed_llama3_2_tpu.serving.catalog import format_key
 # program kinds that run model math — these must carry nonzero FLOPs
 # after harvest (the graftcheck GC009 completeness contract); the
 # remaining kinds only move bytes and report their element traffic
-COMPUTE_KINDS = frozenset({"pctx", "psfx", "pdecode", "pverify"})
+COMPUTE_KINDS = frozenset({"pctx", "psfx", "pdecode", "pverify", "pmixed"})
 MOVE_KINDS = frozenset({"copy_block", "lane_set", "table_delta"})
 
 
@@ -227,6 +227,16 @@ def analytic_cost(key: tuple, dims: EngineDims) -> Tuple[float, float, str]:
         )
         rows = dims.max_batch * (kv + k)
         tokens = dims.max_batch * (k + 1)
+    elif kind == "pmixed":
+        # fused mixed-mode step: B lanes × t query rows over the shared
+        # pool — the verify formula at draft width k = t - 1 (a prefill
+        # chunk row costs the same row of attention as a verify row)
+        t, kv = int(key[1]), int(key[2])
+        f = dims.max_batch * t * _flops_per_token(
+            dims, kv + t - 1, dims.quant_mxu
+        )
+        rows = dims.max_batch * (kv + t - 1)
+        tokens = dims.max_batch * t
     elif kind == "copy_block":
         elems = 2 * dims.num_layers * dims.block_size \
             * dims.kv_heads_local * dims.head_dim

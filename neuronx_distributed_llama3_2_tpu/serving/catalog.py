@@ -104,6 +104,11 @@ class BucketLadder:
     prefill_buckets: Tuple[int, ...]
     kv_buckets: Tuple[int, ...]
     verify_t: Tuple[int, ...] = ()
+    # fused-step row-width rungs (PagedConfig.fused_step): each rung is
+    # the fixed query-row count T of a pmixed program packing
+    # prefill-chunk, verify and decode rows into one grid — one rung per
+    # engine today (max(prefill_chunk_tokens or 8, spec_k + 1))
+    mixed_t: Tuple[int, ...] = ()
 
     def kv_bucket(self, needed: int) -> int:
         """Smallest kv rung covering ``needed`` rows, clamped to the full
@@ -149,6 +154,10 @@ class CatalogManifest:
     quantized: bool = False
     checked: bool = False
     gather_variants: bool = False
+    # PagedConfig.fused_step: prefill suffixes ride the pmixed grid, so
+    # the psfx keys leave the universe entirely and the mixed_t × kv
+    # ladder replaces the psfx suffix-pair product (the GC007 shrink)
+    fused_step: bool = False
 
     @classmethod
     def from_engine(cls, engine: Any) -> "CatalogManifest":
@@ -157,12 +166,14 @@ class CatalogManifest:
         checked bits, and whether the degradation ladder may mint
         gather twins."""
         spec_k = int(getattr(engine, "_spec_k", 0) or 0)
+        mixed_t = int(getattr(engine, "_mixed_t", 0) or 0)
         ladder = BucketLadder(
             decode_batch=engine.engine.max_batch,
             max_seq_len=engine.engine.max_seq_len,
             prefill_buckets=tuple(engine._prefill_buckets),
             kv_buckets=tuple(engine._kv_buckets),
             verify_t=(spec_k,) if spec_k else (),
+            mixed_t=(mixed_t,) if mixed_t else (),
         )
         return cls(
             ladder=ladder,
@@ -176,6 +187,7 @@ class CatalogManifest:
             quantized=bool(getattr(engine, "_kv_quantized", False)),
             checked=bool(getattr(engine, "_check_logits", False)),
             gather_variants=bool(engine.paged.degrade_after_faults),
+            fused_step=bool(getattr(engine, "_fused_step", False)),
         )
 
     def _expand(self, gathers: Tuple[bool, ...]) -> List[tuple]:
@@ -188,13 +200,20 @@ class CatalogManifest:
         for g in gathers:
             for b in lad.prefill_buckets:
                 keys.append(("pctx", b, cfg, g))
-            for b, kv in lad.suffix_pairs():
-                keys.append(("psfx", b, kv, cfg, g))
+            if not self.fused_step:
+                # fused mode NEVER dispatches a suffix prefill: cached > 0
+                # admissions route to the pmixed grid, so the psfx
+                # suffix-pair product leaves the universe entirely
+                for b, kv in lad.suffix_pairs():
+                    keys.append(("psfx", b, kv, cfg, g))
             for kv in lad.kv_buckets:
                 keys.append(("pdecode", cfg, kv, g, chk))
             for k in lad.verify_t:
                 for kv in lad.kv_buckets:
                     keys.append(("pverify", kv, k, g, chk))
+            for t in lad.mixed_t:
+                for kv in lad.kv_buckets:
+                    keys.append(("pmixed", t, kv, cfg, g, chk))
         return keys
 
     def keys(self) -> FrozenSet[tuple]:
@@ -220,9 +239,12 @@ class CatalogManifest:
             ("quant", self.quantized), ("checked", self.checked),
             ("gather-variants", self.gather_variants),
         ) if on]
+        if self.fused_step:
+            flags.append("fused-step")
         return (
             f"B={lad.decode_batch} prefill={list(lad.prefill_buckets)} "
             f"kv={list(lad.kv_buckets)} verify_t={list(lad.verify_t)} "
+            f"mixed_t={list(lad.mixed_t)} "
             f"cfg={_format_sampling(self.sampling)}"
             + (f" [{','.join(flags)}]" if flags else "")
             + f" -> {len(self.keys())} keys"
@@ -245,6 +267,13 @@ def validate_ladder(model: Any, ladder: BucketLadder) -> List[str]:
                 f"verify_t={k} (T={k + 1}) exceeds the paged kernel's "
                 "linear bound — every verify dispatch at this width takes "
                 "the dense-gather path"
+            )
+    for t in ladder.mixed_t:
+        if path_of(t) != "kernel":
+            out.append(
+                f"mixed_t={t} exceeds the paged kernel's linear bound — "
+                "every fused mixed-mode dispatch takes the dense-gather "
+                "path (shrink prefill_chunk_tokens / spec_draft_tokens)"
             )
     return out
 
@@ -289,6 +318,9 @@ def format_key(key: tuple) -> str:
     elif kind == "pverify":
         _, kv, k, gather, checked = key
         bits = [f"kv_limit={kv}", f"k={k}"]
+    elif kind == "pmixed":
+        _, t, kv, cfg, gather, checked = key
+        bits = [f"t={t}", f"kv_limit={kv}", f"cfg={_format_sampling(cfg)}"]
     elif kind == "copy_block":
         bits = [f"quantized={key[1]}"]
     else:  # lane_set / table_delta / future kinds: render fields raw

@@ -189,6 +189,17 @@ class PagedConfig:
     # a long prompt no longer stalls every decode stream for its whole
     # prefill. None/0 = off (whole-suffix prefill at admission, as before).
     prefill_chunk_tokens: Optional[int] = None
+    # fused mixed-mode step (docs/serving.md "Fused mixed-mode step"): pack
+    # decode lanes, speculative-verify rows and this step's active
+    # prefill-chunk suffixes into ONE multi-row program (`pmixed`) over the
+    # shared paged pool, dispatched once per step — the separate
+    # per-prefilling-lane psfx dispatch loop disappears and the catalog
+    # sheds the psfx bucket×kv product for a single mixed t rung. Token-
+    # identical to the unfused engine; pure-decode steady state still runs
+    # the plain pdecode/pverify programs (zero-upload, GC003). Host
+    # sampling must be greedy (on_device_sampling lifts that, exactly as
+    # it does for speculation).
+    fused_step: bool = False
     # async double-buffered decode (docs/serving.md "Async step pipeline"):
     # when no scheduler event is pending, dispatch step N+1 from the
     # device-resident state before reading step N's tokens back, so host
@@ -416,6 +427,25 @@ class PagedServingEngine:
                 "(SamplingConfig(greedy=True)) — or turn on "
                 "PagedConfig.on_device_sampling for sampled verify"
             )
+        # fused mixed-mode step (docs/serving.md "Fused mixed-mode step"):
+        # one pmixed dispatch serves decode + verify + prefill-chunk rows
+        # whenever any lane is mid-prefill; the mixed row width t covers
+        # the chunk budget and the widest verify block
+        self._fused_step = bool(paged.fused_step)
+        if self._fused_step and not gen.sampling.greedy and not self._fused:
+            # the mixed program draws every row's token in one dispatch —
+            # a host-keyed sampled stream cannot replay the unfused
+            # engine's per-program key-split order. Fused sampling keys
+            # draws by landing index, which is dispatch-shape-independent.
+            raise ValueError(
+                "fused_step with host sampling requires greedy "
+                "(SamplingConfig(greedy=True)) — or turn on "
+                "PagedConfig.on_device_sampling for sampled mixed steps"
+            )
+        self._mixed_t = (
+            max(int(paged.prefill_chunk_tokens or 8), self._spec_k + 1)
+            if self._fused_step else 0
+        )
         self.drafter = drafter
         if self._spec_k and self.drafter is None:
             from neuronx_distributed_llama3_2_tpu.serving.drafter import (
@@ -436,6 +466,7 @@ class PagedServingEngine:
         # outcome flags the policy generator reads after an action executes
         self._last_verify_drafted = False
         self._last_async_fell_back = False
+        self._last_mixed_dispatched = False
         # graftsched action trace: per-step (step_index, pending_at_start,
         # [StepAction...]) records, ring-bounded like the flight recorder;
         # analysis/graftsched.py replays it against the legality automaton
@@ -1106,6 +1137,73 @@ class PagedServingEngine:
             kv_limit=kv_limit, k=k,
         )
 
+    def _mixed_program(self, t: int, kv_limit: int):
+        """Fused mixed-mode step (``PagedConfig.fused_step``): ONE t-row
+        program serving every lane role at once — decode lanes ride as a
+        ``[resident token, drafts...]`` verify block (draft_len 0 is a
+        plain decode row), prefilling lanes as *forced* rows carrying this
+        step's chunk suffix, sampled/argmaxed at the chunk's last live row
+        exactly like the psfx program (``LlamaDecode.mixed_step``). Cache
+        and positions are donated like decode/verify; the per-step row
+        payload (rows/row_start/row_len/forced) uploads like verify's
+        drafts — prefill traffic always paid per-call uploads, and the
+        pure-decode steady state never dispatches this kind (GC003 holds).
+        Fused-sampling and checked variants mirror ``_verify_program``."""
+        checked = self._check_logits
+        cfg = self._decode_cfg()
+        key_ = ("pmixed", t, kv_limit, cfg, self._gather_shed(), checked)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model, engine = self._step_model(), self.engine
+        pos_cap = self._pos_cap
+
+        if self._fused and checked:
+            def fn(params, cache, tokens, positions, tables, rows,
+                   row_start, row_len, forced, temp, topk, topp, rng,
+                   nan_mask):
+                params = engine._live_params(params)
+                return model.mixed_step(
+                    params, cache, tokens, positions, tables,
+                    rows, row_start, row_len, forced,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                    sampling=(rng, temp, topk, topp), logit_poison=nan_mask,
+                )
+        elif self._fused:
+            def fn(params, cache, tokens, positions, tables, rows,
+                   row_start, row_len, forced, temp, topk, topp, rng):
+                params = engine._live_params(params)
+                return model.mixed_step(
+                    params, cache, tokens, positions, tables,
+                    rows, row_start, row_len, forced,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                    sampling=(rng, temp, topk, topp),
+                )
+        elif checked:
+            def fn(params, cache, tokens, positions, tables, rows,
+                   row_start, row_len, forced, nan_mask):
+                params = engine._live_params(params)
+                return model.mixed_step(
+                    params, cache, tokens, positions, tables,
+                    rows, row_start, row_len, forced,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                    logit_poison=nan_mask,
+                )
+        else:
+            def fn(params, cache, tokens, positions, tables, rows,
+                   row_start, row_len, forced):
+                params = engine._live_params(params)
+                return model.mixed_step(
+                    params, cache, tokens, positions, tables,
+                    rows, row_start, row_len, forced,
+                    kv_limit=kv_limit, pos_cap=pos_cap,
+                )
+
+        return self._register_program(
+            key_, fn, donate_argnums=(1, 3), kind="pmixed",
+            gather=self._gather_shed(), checked=checked,
+            kv_limit=kv_limit, t=t,
+        )
+
     def _lane_set_program(self):
         """Full-lane resident-state update: scatter one lane's (token,
         position, table row) into the device arrays — the admission /
@@ -1740,6 +1838,26 @@ class PagedServingEngine:
                     else:
                         _, _, toks, self._d_positions, self.cache = fn(*args)
                     self._d_tokens = toks
+                elif kind == "pmixed":
+                    _, t, kv, _cfg, _g, _c = key_
+                    fn = self._mixed_program(t, kv)
+                    # all-zero row payload: every lane is a draft-len-0
+                    # decode row, so the warmup is exactly a pdecode-shaped
+                    # null-block write plus resident rewrite
+                    args = (
+                        eng.params, self.cache, self._d_tokens,
+                        self._d_positions, self._d_tables,
+                        jnp.zeros((eng.max_batch, t), jnp.int32),
+                        zeros_b, zeros_b, zeros_b,
+                        *(d_tail() if self._fused else ()),
+                    )
+                    if self._check_logits:
+                        _, _, toks, self._d_positions, _, self.cache = fn(
+                            *args, self._nan_mask((), "warmup")
+                        )
+                    else:
+                        _, _, toks, self._d_positions, self.cache = fn(*args)
+                    self._d_tokens = toks
                 else:  # pragma: no cover - manifest/engine kind drift
                     raise ValueError(f"prewarm: unknown program kind {kind!r}")
             for warning in validate_ladder(self.model, self.catalog.ladder):
@@ -2037,7 +2155,9 @@ class PagedServingEngine:
                 req.admitted_at = time.perf_counter()
             self.tracer.request_state(req.rid, "prefilling")
             chunk = self.paged.prefill_chunk_tokens
-            if chunk and len(seq) - cached > chunk:
+            if (chunk and len(seq) - cached > chunk) or (
+                self._fused_step and cached > 0
+            ):
                 # chunked admission: the lane holds its blocks but joins the
                 # decode batch only after the final chunk. Until then the
                 # decode-visible table row stays all-null — the batched
@@ -2046,11 +2166,30 @@ class PagedServingEngine:
                 # request's real blocks mid-prefill. Prefix registration is
                 # deferred too: the blocks hold valid tokens only when the
                 # last chunk completes.
+                #
+                # Fused mixed-mode step: EVERY cached-prefix admission walks
+                # this route (the psfx program kind is never dispatched) and
+                # the full allocated table goes live immediately — the
+                # pmixed program reads and writes the chunk rows through the
+                # decode-visible row. Safe under the overwrite-frontier
+                # invariant: a garbage row the batched program writes is
+                # always rewritten by the dispatch that first admits it into
+                # a mask, and rows past the allocation land in the null
+                # block.
                 req.prefilling = True
                 req.prefill_pos = cached
                 req.prefill_target = len(seq)
                 self._tokens[lane] = 0
                 self._positions[lane] = 0
+                if self._fused_step:
+                    self._tables[lane, : len(table)] = table
+                    # park the resident write row PAST the prompt: row 0 of
+                    # a live table can be a *shared* prefix block, and any
+                    # batched program writes garbage at every lane's
+                    # resident row — prefill_target's row is private (or
+                    # null past the allocation) and decode overwrites it
+                    # before any mask admits it
+                    self._positions[lane] = req.prefill_target
                 self._dirty_lanes.add(lane)
                 continue
             suffix = seq[cached:]
@@ -2832,6 +2971,221 @@ class PagedServingEngine:
             self._quarantine(req, "verify")
         return True
 
+    def _mixed_phase(self) -> bool:
+        """The MIXED_DISPATCH action body (``PagedConfig.fused_step``): ONE
+        ``pmixed`` dispatch advances every lane role this step. Prefilling
+        lanes consume their next chunk suffix as *forced* rows — non-final
+        chunks discard their sampled row exactly like psfx chunks did, the
+        final chunk's last-row draw (keyed ``start + length``, the psfx
+        key) is the request's next token and the program itself installs
+        the lane's resident (token, position), no lane_set needed. Decode
+        lanes ride as a verify block over the same grid (draft_len 0 is a
+        plain decode row), so a step with prefills in flight costs one
+        program dispatch instead of one psfx per prefilling lane plus a
+        decode/verify. Same-step readback like verify: accept lengths and
+        final-chunk tokens decide how far each lane's host state advances.
+        Returns ``dispatched``: False means no lane is mid-prefill (or
+        backing preempted them all) and the policy is expected to
+        schedule the plain verify/decode tail instead."""
+        if not self._fused_step:
+            return False
+        if not any(r.prefilling for r in self._active.values()):
+            return False
+        t = self._mixed_t
+        proposals: Dict[int, List[int]] = {}
+        if self._spec_k:
+            # mixed rows cap drafts at t - 1 (row 0 is the resident token)
+            proposals = {
+                l: d[: t - 1] for l, d in self._collect_drafts().items()
+            }
+            if proposals:
+                self._prepare_spec_blocks(proposals)
+        self._ensure_decode_blocks()
+        # backing may have preempted lanes (youngest first): re-derive
+        # every role set from the surviving active map
+        proposals = {
+            l: d for l, d in proposals.items()
+            if self._active.get(l) is not None
+            and not self._active[l].prefilling
+        }
+        forced_lanes = sorted(
+            l for l, r in self._active.items() if r.prefilling
+        )
+        decode_lanes = [
+            l for l, r in self._active.items() if not r.prefilling
+        ]
+        if not forced_lanes:
+            return False  # every prefilling lane was preempted/failed away
+        self._chaos_device("mixed", forced_lanes + decode_lanes)
+        self._flush_state()
+        eng = self.engine
+        rows = np.zeros((eng.max_batch, t), np.int32)
+        row_start = np.zeros((eng.max_batch,), np.int32)
+        row_len = np.zeros((eng.max_batch,), np.int32)
+        forced = np.zeros((eng.max_batch,), np.int32)
+        # lane -> (req, chunk start, chunk piece, is-final-chunk)
+        pieces: Dict[int, tuple] = {}
+        for lane in forced_lanes:
+            req = self._active[lane]
+            seq = req.prompt + req.out
+            start = req.prefill_pos
+            piece = seq[start: start + t]
+            pieces[lane] = (
+                req, start, piece, start + len(piece) >= req.prefill_target,
+            )
+            rows[lane, : len(piece)] = piece
+            row_start[lane] = start
+            row_len[lane] = len(piece)
+            forced[lane] = 1
+        for lane, d in proposals.items():
+            rows[lane, : len(d)] = d
+            row_len[lane] = len(d)
+        kv_need = max(
+            max(start for _, start, _, _ in pieces.values()),
+            max(
+                (int(self._positions[l]) for l in decode_lanes), default=0
+            ),
+        ) + t
+        kv_limit = self._kv_bucket(kv_need)
+        fn = self._mixed_program(t, kv_limit)
+        self.metrics.note_decode_dispatch(
+            kv_limit, kv_need,
+            *(self._flops_by_key.get(fn.key) or (0.0, 0.0)),
+        )
+        smode = self._note_sampling_dispatch()
+        tr = self.tracer
+        t_d = time.perf_counter()
+        args = (
+            eng.params, self.cache,
+            self._d_tokens, self._d_positions, self._d_tables,
+            self._upload(rows), self._upload(row_start),
+            self._upload(row_len), self._upload(forced),
+        )
+        if self._fused:
+            args += (
+                self._d_temps, self._d_topks, self._d_topps, self._d_rng,
+            )
+        if self._check_logits:
+            (
+                emitted_d, accept_d, new_tokens, self._d_positions,
+                finite_d, self.cache,
+            ) = fn(
+                *args,
+                self._nan_mask(forced_lanes + decode_lanes, "mixed"),
+            )
+        else:
+            finite_d = None
+            emitted_d, accept_d, new_tokens, self._d_positions, self.cache = (
+                fn(*args)
+            )
+        t_d1 = time.perf_counter()
+        if tr.enabled:
+            # the row-role breakdown IS the trace payload: how many packed
+            # rows each role contributed to this one dispatch
+            tr.complete(
+                "dispatch", t_d, t_d1, program=program_label(fn),
+                mode="mixed", sampling=smode,
+                lanes=len(forced_lanes) + len(decode_lanes),
+                decode_rows=len(decode_lanes) - len(proposals),
+                verify_rows=len(proposals),
+                prefill_rows=len(forced_lanes),
+                prefill_tokens=sum(len(p) for _, _, p, _ in pieces.values()),
+                drafts=sum(len(d) for d in proposals.values()),
+                kv_bucket=kv_limit, kv_pad=kv_limit - kv_need,
+            )
+        self._d_tokens = new_tokens
+        self._dispatch_count += 1
+        self.metrics.mixed_dispatches += 1
+        self._emit_action(
+            ActionType.MIXED_DISPATCH,
+            lanes=list(decode_lanes), prefill_lanes=list(forced_lanes),
+            drafts=sum(len(d) for d in proposals.values()), kv=kv_limit,
+        )
+        if decode_lanes:
+            self.metrics.decode_steps += 1
+        if proposals:
+            self.metrics.verify_steps += 1
+            self.metrics.draft_tokens += sum(
+                len(d) for d in proposals.values()
+            )
+        emitted = self._read_tokens(emitted_d)      # (B, t)
+        accept = self._read_tokens(accept_d)        # (B,)
+        fin = None if finite_d is None else self._read_tokens(finite_d)
+        self._last_readback_lag = 0
+        cfg = self.paged
+        bs = cfg.block_size
+        wall_ms = (t_d1 - t_d) * 1e3
+        finishing: List[_PagedRequest] = []
+        quarantined: List[_PagedRequest] = []
+        for lane, (req, start, piece, final) in pieces.items():
+            if fin is not None and not bool(fin[lane]):
+                quarantined.append(req)
+                continue
+            req.prefill_pos = start + len(piece)
+            req.prefill_ms += wall_ms
+            self.metrics.prefill_tokens += len(piece)
+            self.metrics.prefill_chunks += 1
+            if not final:
+                # the device resident advanced to (garbage draw, next
+                # chunk start); the next forced dispatch re-keys off the
+                # uploaded row_start, so the host position mirror stays
+                # parked at the post-prompt row admission installed
+                continue
+            # final chunk: the program already wrote the lane's resident
+            # (sampled token, position) — mirror them host-side, commit
+            # the first token, register the prompt for prefix sharing
+            tok = int(emitted[lane, len(piece) - 1])
+            req.prefilling = False
+            req.table_dev = None
+            req.out.append(tok)
+            req.position = req.prefill_target
+            self._note_first_token(req)
+            self.tracer.request_state(req.rid, "active")
+            self._tokens[lane] = tok
+            self._positions[lane] = req.position
+            if cfg.enable_prefix_caching:
+                seq = req.prompt + req.out[:-1]
+                n_full = len(seq) // bs
+                if n_full:
+                    self.index.insert(seq[: n_full * bs], req.table[:n_full])
+            if self._finish_due(req):
+                finishing.append(req)
+        for lane in decode_lanes:
+            req = self._active[lane]
+            if fin is not None and not bool(fin[lane]):
+                quarantined.append(req)
+                continue
+            a = int(accept[lane])
+            dl = int(row_len[lane])
+            self.metrics.accepted_tokens += a
+            if dl:
+                self.metrics.hist_accept_len.observe(a)
+            req.spec_drafted += dl
+            req.spec_accepted += a
+            self._positions[lane] += a + 1  # mirror the on-device advance
+            for j in range(a + 1):
+                req.out.append(int(emitted[lane, j]))
+                req.position += 1
+                self._tokens[lane] = emitted[lane, j]
+                if req.position >= eng.max_seq_len - 1:
+                    req.done = True
+                if self._finish_due(req):
+                    break
+            if self._finish_due(req):
+                finishing.append(req)
+            elif (
+                not req.spec_disabled
+                and req.spec_drafted >= cfg.spec_probation_tokens
+                and req.spec_accepted < cfg.spec_min_accept_rate * req.spec_drafted
+            ):
+                req.spec_disabled = True
+                self.metrics.spec_disabled_lanes += 1
+        for req in finishing:
+            self._maybe_finish(req)
+        for req in quarantined:
+            self._quarantine(req, "mixed")
+        return True
+
     # backstop against a runaway policy generator (the explorer drives
     # arbitrary third-party schedules through this loop)
     _MAX_ACTIONS_PER_STEP = 64
@@ -2854,10 +3208,18 @@ class PagedServingEngine:
                 self._reorder_queue(order)
             self._admit()
         elif t is ActionType.PREFILL_CHUNK:
-            budget = act.meta.get("budget_tokens") if act.meta else None
-            self._advance_prefills(budget_tokens=budget)
+            if self._fused_step:
+                # fused mode never dispatches psfx (the keys are not even
+                # in the catalog): a fused-unaware policy's PREFILL_CHUNK
+                # routes to the mixed program instead
+                self._last_mixed_dispatched = self._mixed_phase()
+            else:
+                budget = act.meta.get("budget_tokens") if act.meta else None
+                self._advance_prefills(budget_tokens=budget)
         elif t is ActionType.VERIFY:
             self._last_verify_drafted = self._verify_phase()
+        elif t is ActionType.MIXED_DISPATCH:
+            self._last_mixed_dispatched = self._mixed_phase()
         elif t is ActionType.DECODE_DISPATCH:
             if act.mode == "async":
                 if self._ensure_decode_blocks_async():
@@ -2927,6 +3289,9 @@ class PagedServingEngine:
         t0 = time.perf_counter()
         self._wait_ms = 0.0
         self._step_index += 1
+        # dispatches_per_step denominator: every step() counts, so the
+        # fused-vs-unfused dispatch reduction is visible per engine step
+        self.metrics.engine_steps += 1
         # fresh per-step action record; everything _emit_action sees until
         # the next step() — including _update_ladder preemptions and fault
         # recovery below — lands in this step's trace entry

@@ -132,7 +132,29 @@ def audit_engine(engine) -> List[str]:
         if lane in engine._dirty_lanes:
             pass  # mirror queued for rewrite; skip the row checks
         elif req.prefilling:
-            if (row != NULL_BLOCK).any():
+            if getattr(engine, "_fused_step", False):
+                # fused mode prefills THROUGH the pmixed grid, so the
+                # mid-prefill table mirror is live; the resident write
+                # position parks at prefill_target (a private or
+                # null-backed row — never a shared prefix block) until
+                # the final chunk lands
+                w = len(req.table)
+                if list(row[:w]) != req.table:
+                    v.append(
+                        f"rid {req.rid}: fused mid-prefill mirror row "
+                        f"{list(row[:w])} != table {req.table}"
+                    )
+                if (row[w:] != NULL_BLOCK).any():
+                    v.append(
+                        f"rid {req.rid}: mirror row live past table end"
+                    )
+                if int(engine._positions[lane]) != req.prefill_target:
+                    v.append(
+                        f"rid {req.rid}: fused mid-prefill resident "
+                        f"position {int(engine._positions[lane])} not "
+                        f"parked at prefill_target {req.prefill_target}"
+                    )
+            elif (row != NULL_BLOCK).any():
                 v.append(
                     f"rid {req.rid}: decode-visible table row live "
                     "mid-chunked-prefill"

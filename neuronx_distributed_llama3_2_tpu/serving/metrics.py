@@ -64,6 +64,14 @@ class ServingMetrics:
     truncated: int = 0        # finished early because the pool can never fit
     preemptions: int = 0      # requests bumped back to the queue
     decode_steps: int = 0
+    # -- fused mixed-mode step (docs/serving.md "Fused mixed-mode step"):
+    #    engine_steps counts every step() (the dispatches_per_step
+    #    denominator); compute_dispatches counts every model-program
+    #    dispatch (pctx/psfx/pdecode/pverify/pmixed — the numerator);
+    #    mixed_dispatches counts the pmixed subset --
+    engine_steps: int = 0
+    compute_dispatches: int = 0
+    mixed_dispatches: int = 0
     prefill_tokens: int = 0   # prompt tokens actually pushed through prefill
     prefill_chunks: int = 0   # chunked-prefill program invocations
     cached_tokens: int = 0    # prompt tokens admitted by prefix reference
@@ -237,6 +245,7 @@ class ServingMetrics:
         required ``need`` kv rows; ``flops``/``bytes_accessed`` are the
         program's static CostProfile figures (0 before harvest)."""
         pad = max(rung - need, 0)
+        self.compute_dispatches += 1
         self.decode_need_tokens += need
         self.decode_pad_tokens += pad
         self._note_rung(self.decode_pad_by_rung, rung, need, pad)
@@ -250,6 +259,7 @@ class ServingMetrics:
         """One prefill (whole or chunk) dispatch padded into ``bucket``
         for ``tokens`` real suffix tokens."""
         pad = max(bucket - tokens, 0)
+        self.compute_dispatches += 1
         self.prefill_need_tokens += tokens
         self.prefill_pad_tokens += pad
         self._note_rung(self.prefill_pad_by_rung, bucket, tokens, pad)
@@ -356,6 +366,10 @@ class ServingMetrics:
         steps = max(self.decode_steps, 1)
         rec["host_schedule_ms_per_step"] = round(self.host_schedule_ms / steps, 4)
         rec["device_wait_ms_per_step"] = round(self.device_wait_ms / steps, 4)
+        # the fused-step reduction gauge: model-program dispatches per
+        # engine step (fused mixed-traffic steady state drives this to 1)
+        rec["dispatches_per_step"] = round(
+            self.compute_dispatches / max(self.engine_steps, 1), 4)
         for key, field_name in _HIST_KEYS.items():
             rec[key] = getattr(self, field_name).snapshot()
         if allocator is not None:

@@ -46,6 +46,7 @@ class ActionType(enum.Enum):
     DECODE_DISPATCH = "DECODE_DISPATCH"    # one T=1 decode (mode: sync/async)
     READBACK = "READBACK"                  # retire a dispatched step
     VERIFY = "VERIFY"                      # speculative multi-token verify
+    MIXED_DISPATCH = "MIXED_DISPATCH"      # fused prefill+decode+verify step
     AUDIT = "AUDIT"                        # invariant auditor pass
     PREEMPT = "PREEMPT"                    # engine-emitted: lane requeued
     FINISH = "FINISH"                      # engine-emitted: lane released
@@ -60,6 +61,7 @@ POLICY_ACTIONS = frozenset({
     ActionType.DECODE_DISPATCH,
     ActionType.READBACK,
     ActionType.VERIFY,
+    ActionType.MIXED_DISPATCH,
     ActionType.AUDIT,
 })
 
@@ -230,6 +232,13 @@ class EngineView:
         backing the write rows would need a preemption?"""
         return self._engine._last_async_fell_back
 
+    @property
+    def last_mixed_dispatched(self) -> bool:
+        """Did the last MIXED_DISPATCH actually dispatch a pmixed program
+        (False: no lane was mid-prefill — or backing preempted them all —
+        and the policy should schedule the plain verify/decode tail)?"""
+        return self._engine._last_mixed_dispatched
+
 
 class StepPolicy:
     """Base class: a policy is a per-step generator of StepActions.
@@ -278,10 +287,19 @@ class FifoPolicy(StepPolicy):
         cfg = view.config
         spec_on = view.spec_enabled and view.degrade_level < 1
         async_on = cfg.async_loop and view.degrade_level < 2
+        fused = bool(getattr(cfg, "fused_step", False))
         if spec_on and self._spec_pause <= 0:
             yield StepAction(ActionType.READBACK)   # drain the lookahead
             yield StepAction(ActionType.ADMIT)
-            yield StepAction(ActionType.PREFILL_CHUNK)
+            if fused and view.prefilling_lanes:
+                # one pmixed dispatch packs the prefill chunks, the verify
+                # rows, and any plain decode lanes — the step is done when
+                # it actually went out (abstention falls through below)
+                yield StepAction(ActionType.MIXED_DISPATCH)
+                if view.last_mixed_dispatched:
+                    return
+            else:
+                yield StepAction(ActionType.PREFILL_CHUNK)
             yield StepAction(ActionType.VERIFY)
             if not view.last_verify_drafted:
                 # dry drafter: hand the loop to the async lookahead for a
@@ -301,7 +319,12 @@ class FifoPolicy(StepPolicy):
             # state — drop to the synchronous sequence for this step
         yield StepAction(ActionType.READBACK)
         yield StepAction(ActionType.ADMIT)
-        yield StepAction(ActionType.PREFILL_CHUNK)
+        if fused and view.prefilling_lanes:
+            yield StepAction(ActionType.MIXED_DISPATCH)
+            if view.last_mixed_dispatched:
+                return
+        else:
+            yield StepAction(ActionType.PREFILL_CHUNK)
         yield StepAction(ActionType.DECODE_DISPATCH, mode="sync")
 
 

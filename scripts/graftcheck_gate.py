@@ -211,6 +211,40 @@ def _catalog_engine(prewarm=True):
     )
 
 
+def _catalog_fused_engine(prewarm=True):
+    """``fused_step`` twin of the catalog-int8 engine: same ladder, int8
+    pool, spec verify, chunked prefill, async lookahead — but every
+    cached>0 admission routes through the one-dispatch ``pmixed`` grid,
+    so the psfx suffix-pair family leaves the manifest entirely. The
+    entry asserts that shrink (fused manifest strictly smaller than the
+    unfused psfx×pdecode expansion) on top of the usual byte-identity
+    contract."""
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    cfg, params = _tiny()
+    return PagedServingEngine(
+        InferenceEngine(
+            cfg, params, max_batch=4, max_seq_len=64, buckets=[8, 16]
+        ),
+        GenerationConfig(max_new_tokens=6),
+        PagedConfig(
+            block_size=8, num_blocks=32, kv_cache_dtype="int8",
+            quant_mxu=True, on_device_sampling=True,
+            spec_draft_tokens=4, prefill_chunk_tokens=6, async_loop=True,
+            fused_step=True,
+            trace_enabled=True, trace_buffer_steps=64, prewarm=prewarm,
+        ),
+        precompile=False,
+    )
+
+
 def _catalog_tp2_engine(prewarm=True):
     """tp=2 catalog twin (caller owns the mesh): bf16 pool, chunked
     prefill, single-bucket ladder — small enough that the 9-key manifest
@@ -395,6 +429,45 @@ def entry_catalog():
     )
 
 
+def entry_catalog_fused():
+    """The fused_step twin under the same heterogeneous traffic: GC001-
+    GC010 over the pmixed-bearing registry, byte-identity against its own
+    golden entry, plus the fused-shrink contract — routing chunked
+    prefill through the mixed grid must leave the manifest STRICTLY
+    smaller than the unfused psfx×pdecode expansion on the same ladder
+    (one mixed_t rung per kv bucket replaces the whole suffix-pair
+    product)."""
+    import dataclasses
+
+    engine = _catalog_fused_engine()
+    fused_keys = set(engine.catalog.keys())
+    unfused = dataclasses.replace(engine.catalog, fused_step=False)
+    assert not any(k[0] == "psfx" for k in fused_keys), (
+        "fused manifest still declares suffix-prefill keys"
+    )
+    assert any(k[0] == "pmixed" for k in fused_keys), (
+        "fused manifest declares no pmixed keys"
+    )
+    assert len(fused_keys) < len(set(unfused.keys())), (
+        f"fused manifest ({len(fused_keys)} keys) is not strictly smaller "
+        f"than the unfused expansion ({len(set(unfused.keys()))} keys)"
+    )
+    _drive_mixed(engine, (3, 5, 7, 13, 20))
+    assert engine.metrics.steadystate_compiles == 0, (
+        "fused catalog engine compiled past the freeze: "
+        f"{engine.metrics.steadystate_compiles}"
+    )
+    assert engine.metrics.mixed_dispatches > 0, (
+        "fused catalog engine never dispatched a pmixed program"
+    )
+    return (
+        audit_programs(engine)
+        + _sched_trace_findings("catalog-fused", engine)
+        + _catalog_drift("catalog-fused", engine)
+        + _costs_drift("catalog-fused", engine)
+    )
+
+
 def entry_catalog_tp2():
     """Same contract under a pure-tp=2 mesh: the prewarmed 9-key manifest
     must bound the shard_mapped registry exactly."""
@@ -532,6 +605,7 @@ def entry_decode_tp2():
 # their own meshes, with catalog-tp2 last.
 CATALOG = (
     ("catalog-int8", entry_catalog),
+    ("catalog-fused", entry_catalog_fused),
     ("decode", entry_decode),
     ("decode-int8", entry_decode_int8),
     ("decode-int8-mxu", entry_decode_int8_mxu),
@@ -588,7 +662,10 @@ def main(argv=None) -> int:
             initialize_model_parallel,
         )
 
-        entries = {"catalog-int8": _catalog_engine(prewarm=False).catalog}
+        entries = {
+            "catalog-int8": _catalog_engine(prewarm=False).catalog,
+            "catalog-fused": _catalog_fused_engine(prewarm=False).catalog,
+        }
         initialize_model_parallel(
             tensor_model_parallel_size=2, devices=jax.devices()[:2]
         )
@@ -611,7 +688,12 @@ def main(argv=None) -> int:
             initialize_model_parallel,
         )
 
-        entries = {"catalog-int8": _cost_lines(_catalog_engine(prewarm=False))}
+        entries = {
+            "catalog-int8": _cost_lines(_catalog_engine(prewarm=False)),
+            "catalog-fused": _cost_lines(
+                _catalog_fused_engine(prewarm=False)
+            ),
+        }
         initialize_model_parallel(
             tensor_model_parallel_size=2, devices=jax.devices()[:2]
         )
@@ -649,6 +731,10 @@ def main(argv=None) -> int:
 
         drift = _costs_drift(
             "catalog-int8", _catalog_engine(prewarm=False), args.costs_file
+        )
+        drift += _costs_drift(
+            "catalog-fused", _catalog_fused_engine(prewarm=False),
+            args.costs_file,
         )
         initialize_model_parallel(
             tensor_model_parallel_size=2, devices=jax.devices()[:2]

@@ -48,6 +48,14 @@ Three measurements for the gather-free paged decode path (docs/serving.md):
    records zero host->device uploads (the GC003 twin for sampled
    traffic); the speedup column is meaningful only on a real chip.
 
+7. **Fused mixed-mode A/B** for ``PagedConfig.fused_step``: the same
+   chunked-prefill-against-decode workload with the fused step off (one
+   psfx per chunk plus a decode per step) and on (one ``pmixed`` program
+   per step), reporting steps/sec and ``dispatches_per_step`` for both.
+   Gates: greedy-output parity, a nonzero pmixed dispatch count, and the
+   fused leg's ``dispatches_per_step`` strictly below the unfused one;
+   steps/sec is reported, not gated.
+
 Gates (record still prints on failure, like kv_block_bench.py):
 
 - per-``kv_limit`` greedy argmax parity, kernel vs gather
@@ -761,6 +769,83 @@ def _sampling_ab(config, params, args):
     }
 
 
+def _fused_ab(config, params, args):
+    """Fused mixed-mode step on/off A/B (docs/serving.md "Fused
+    mixed-mode step").
+
+    The same mixed workload — short prompts decoding while a long prompt
+    chunk-prefills through the middle of the run — with
+    ``PagedConfig.fused_step`` off (one psfx per chunk plus a decode per
+    step) and on (one pmixed program per step). Gates: greedy-output
+    parity and a strictly lower ``dispatches_per_step`` on the fused leg
+    with a nonzero pmixed count; steps/sec is reported, not gated (on
+    CPU the packed grid is not cheaper — the win is host dispatch
+    latency and pad waste on a real chip)."""
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    shorts = [
+        rng.integers(0, config.vocab_size, size=(args.short_tokens,)).tolist()
+        for _ in range(args.short_prompts)
+    ]
+    long_prompt = rng.integers(
+        0, config.vocab_size, size=(args.long_tokens,)
+    ).tolist()
+    gen = GenerationConfig(max_new_tokens=args.max_new_tokens)
+    buckets = [x for x in (8, 16, 32, 64, 128) if x <= args.max_seq_len]
+    num_blocks = 4 * (args.max_seq_len // args.block_size)
+
+    def run(fused):
+        eng = InferenceEngine(
+            config, params,
+            max_batch=args.max_batch, max_seq_len=args.max_seq_len,
+            buckets=buckets,
+        )
+        paged = PagedServingEngine(
+            eng, gen,
+            PagedConfig(
+                block_size=args.block_size, num_blocks=num_blocks,
+                prefill_chunk_tokens=args.prefill_chunk_tokens,
+                fused_step=fused,
+            ),
+        )
+        for p in shorts:
+            paged.submit(p)
+        t0 = time.perf_counter()
+        alive = paged.step()
+        paged.submit(long_prompt)  # chunk-prefills against live decode
+        while alive:
+            alive = paged.step()
+        wall = time.perf_counter() - t0
+        m = paged.metrics
+        return (
+            paged.run_to_completion(),
+            m.engine_steps / wall,
+            round(m.compute_dispatches / max(m.engine_steps, 1), 4),
+            m,
+        )
+
+    out_plain, sps_plain, dps_plain, _ = run(False)
+    out_fused, sps_fused, dps_fused, m = run(True)
+    return {
+        "fused_steps_per_s": round(sps_fused, 2),
+        "unfused_steps_per_s": round(sps_plain, 2),
+        "fused_parity": out_plain == out_fused,
+        "fused_dispatches_per_step": dps_fused,
+        "unfused_dispatches_per_step": dps_plain,
+        "fused_mixed_dispatches": int(m.mixed_dispatches),
+    }
+
+
 def run_bench(args: argparse.Namespace) -> dict:
     import jax
 
@@ -780,6 +865,7 @@ def run_bench(args: argparse.Namespace) -> dict:
     tp_ab = _tp_ab(config, params, args)
     quant = _quant_ab(config, params, args)
     samp = _sampling_ab(config, params, args)
+    fused = _fused_ab(config, params, args)
 
     record = {
         "bench": "paged_decode",
@@ -796,6 +882,7 @@ def run_bench(args: argparse.Namespace) -> dict:
         **tp_ab,
         **quant,
         **samp,
+        **fused,
     }
     failures = []
     for c in cases:
@@ -846,6 +933,19 @@ def run_bench(args: argparse.Namespace) -> dict:
             "fused sampled decode paid "
             f"{samp['sampling_steady_decode_uploads']} steady-state "
             "h2d upload(s) (zero-upload contract broken)"
+        )
+    if not fused["fused_parity"]:
+        failures.append(
+            "fused mixed-mode outputs diverge from the unfused engine"
+        )
+    if fused["fused_mixed_dispatches"] < 1:
+        failures.append("fused leg dispatched no pmixed program")
+    if (fused["fused_dispatches_per_step"]
+            >= fused["unfused_dispatches_per_step"]):
+        failures.append(
+            "fused_step failed to reduce dispatches/step "
+            f"({fused['fused_dispatches_per_step']} vs "
+            f"{fused['unfused_dispatches_per_step']} unfused)"
         )
     if failures:
         record["gate_failure"] = "; ".join(failures)

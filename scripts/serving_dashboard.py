@@ -105,6 +105,20 @@ def render_snapshot(snap: dict) -> str:
     if accept and accept.get("count"):
         lines.insert(lines.index(_hist_row("queue", g("queue_depth", {}))),
                      _hist_row("accept", accept))
+    # fused mixed-mode step panel (docs/serving.md "Fused mixed-mode
+    # step"): dispatches per engine step — the figure fused_step exists
+    # to drive toward 1.0 — plus how many dispatches were pmixed. Only
+    # rendered for snapshots that carry the counters (newer records).
+    if "dispatches_per_step" in snap:
+        lines.insert(
+            lines.index("latency (ms)"),
+            (
+                f"dispatch   {g('dispatches_per_step', 0.0)}/step "
+                f"(compute {g('compute_dispatches', 0)} over "
+                f"{g('engine_steps', 0)} steps, "
+                f"mixed {g('mixed_dispatches', 0)})"
+            ),
+        )
     # graftmeter panels (docs/serving.md "Cost accounting & SLOs"): only
     # rendered when the snapshot carries the cost-accounting keys, so the
     # dashboard still draws pre-graftmeter records
@@ -336,6 +350,9 @@ def _demo() -> int:
         PagedConfig(
             block_size=8, num_blocks=32, async_loop=True,
             trace_enabled=True,
+            # fused mixed-mode demo coverage: the dispatch panel row
+            # shows a nonzero pmixed count
+            fused_step=True, prefill_chunk_tokens=4,
             # graftplan demo coverage: a TablePolicy engine so the
             # policy panel renders (the demo table loads below)
             step_policy="table",

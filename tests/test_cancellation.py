@@ -171,6 +171,38 @@ def test_cancel_mid_prefill_and_mid_decode(params, chunk_baseline, when):
     _check_parity(paged, baseline, victim, cancelled_at)
 
 
+@pytest.mark.parametrize(
+    "when",
+    ["mid_prefill", "mid_decode"],
+)
+def test_cancel_mid_fused_step(params, chunk_baseline, when):
+    """fused_step legs of the chunk matrix: the victim's chunk walk rides
+    the one-dispatch pmixed grid, the cancel lands between fused
+    dispatches, and the survivors must stay byte-identical to the
+    UNFUSED uncancelled baseline — cancellation parity and fused-step
+    token parity pinned by the same assertion."""
+    gen, prompts = _CHUNK_GEN, _CHUNK_PROMPTS
+    cfg = dict(_CHUNK_CFG, fused_step=True)
+    victim = 1
+    baseline = chunk_baseline
+
+    if when == "mid_prefill":
+        pred = lambda info: info["prefilling"]  # noqa: E731
+    else:
+        pred = lambda info: info["generated_tokens"] >= 2  # noqa: E731
+    paged, cancelled_at = _run_with_cancel(
+        lambda: _paged(params, gen, PagedConfig(**cfg)),
+        prompts, victim, pred,
+    )
+    assert paged.metrics.mixed_dispatches > 0
+    if when == "mid_prefill":
+        assert cancelled_at == []  # no token ever committed
+    else:
+        assert 2 <= len(cancelled_at) < len(baseline[victim])
+        assert cancelled_at == baseline[victim][: len(cancelled_at)]
+    _check_parity(paged, baseline, victim, cancelled_at)
+
+
 def test_cancel_mid_verify_speculative(params):
     """Speculative engine: cancel between verify steps while the victim
     has accepted drafted tokens. The drain-then-fail path must unwind the

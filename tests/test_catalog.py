@@ -144,6 +144,41 @@ def test_manifest_spec_adds_verify_widths(params):
     }
 
 
+def test_manifest_fused_step_shrinks_expansion(params):
+    """The GC007 fused-shrink contract: fused_step swaps the psfx
+    suffix-pair product for one pmixed rung per kv bucket, so the
+    manifest must be STRICTLY smaller than the unfused expansion on the
+    same ladder (the gate's catalog-fused entry asserts the same
+    relation on the full int8 configuration)."""
+    lad = cat.BucketLadder(
+        decode_batch=4, max_seq_len=64,
+        prefill_buckets=(8, 16, 64), kv_buckets=(8, 16, 64),
+        verify_t=(4,), mixed_t=(6,),
+    )
+    fused = cat.CatalogManifest(ladder=lad, sampling=GREEDY, fused_step=True)
+    unfused = cat.CatalogManifest(ladder=lad, sampling=GREEDY)
+    fk, uk = fused.keys(), unfused.keys()
+    assert not any(k[0] == "psfx" for k in fk)
+    assert {k for k in fk if k[0] == "pmixed"} == {
+        ("pmixed", 6, 8, GREEDY, False, False),
+        ("pmixed", 6, 16, GREEDY, False, False),
+        ("pmixed", 6, 64, GREEDY, False, False),
+    }
+    # 4 suffix pairs leave, 3 pmixed rungs arrive: strictly smaller
+    assert len(fk) < len(uk)
+    # everything else is shared — the shrink is pure psfx-for-pmixed
+    assert {k for k in fk if k[0] != "pmixed"} == {
+        k for k in uk if k[0] not in ("psfx", "pmixed")
+    }
+    # a small TINY engine pair shows the same routing end to end
+    feng = _engine(
+        params, fused_step=True, prefill_chunk_tokens=4,
+        spec_draft_tokens=2,
+    )
+    assert not any(k[0] == "psfx" for k in feng.catalog.keys())
+    assert any(k[0] == "pmixed" for k in feng.catalog.keys())
+
+
 def test_manifest_gather_variants_legal_but_not_prewarmed(params):
     """degrade_after_faults arms the kernel-shed ladder: gather twins
     become LEGAL keys (GC007) but prewarm never compiles them (GC006
@@ -235,6 +270,21 @@ def test_validate_ladder_flags_oversize_verify_width():
     ok = dataclasses.replace(lad, verify_t=(3,))
     assert cat.validate_ladder(_Model(), ok) == []
     assert cat.validate_ladder(object(), lad) == []  # duck-typed: no hook
+
+
+def test_validate_ladder_flags_oversize_mixed_width():
+    class _Model:
+        def paged_dispatch_path(self, t, tree=None):
+            return "kernel" if t <= 4 else "gather"
+
+    lad = cat.BucketLadder(
+        decode_batch=4, max_seq_len=64,
+        prefill_buckets=(8,), kv_buckets=(8,), mixed_t=(8,),
+    )
+    (warning,) = cat.validate_ladder(_Model(), lad)
+    assert "mixed_t=8" in warning
+    ok = dataclasses.replace(lad, mixed_t=(4,))
+    assert cat.validate_ladder(_Model(), ok) == []
 
 
 # ------------------------------------------------------- prewarm contract

@@ -321,6 +321,40 @@ def test_nan_quarantine_fails_the_poisoned_lane(params, cfg):
     _assert_clean_pool(paged)
 
 
+CFG_FUSED = PagedConfig(
+    block_size=8, num_blocks=64, prefill_chunk_tokens=6, fused_step=True,
+)
+
+
+@pytest.mark.parametrize("kind", ["device", "nan"])
+def test_fused_step_fault_fails_one_lane_others_identical(params, kind):
+    """The failure domain of a fused mixed-mode dispatch is still ONE
+    lane: even though prefill-chunk, verify, and decode rows ride a
+    single pmixed program, a fault at its funnel aborts only the chosen
+    victim, and every survivor stays token-identical to the fault-free
+    UNFUSED run — failure-domain parity and fused token parity pinned by
+    the same assertion."""
+    baseline = _baseline(
+        params, GEN10, dataclasses.replace(CFG_FUSED, fused_step=False),
+        PLAIN_PROMPTS,
+    )
+    # step 2: rid 2 (len 20, chunk 6) is still mid-chunk-walk, so the
+    # first device/nan opportunity at or after it is the mixed funnel
+    inj = FaultInjector(FaultPlan(seed=4, schedule=((2, kind),)))
+    paged = _paged(params, GEN10, CFG_FUSED, injector=inj)
+    _run(paged, PLAIN_PROMPTS)
+    assert inj.counts[kind] == 1
+    assert inj.fired[0][2] == "mixed"  # fired at the fused dispatch
+    assert paged.metrics.mixed_dispatches > 0
+    if kind == "nan":
+        assert paged._check_logits  # nan plan implies checked pmixed
+        assert paged.metrics.lane_quarantines == 1
+    n_finished, n_failed = _assert_survivor_parity(paged, baseline)
+    assert (n_finished, n_failed) == (3, 1)
+    assert paged.metrics.failed_requests == 1
+    _assert_clean_pool(paged)
+
+
 def test_detect_nonfinite_clean_run_changes_nothing(params):
     # checked programs with healthy logits: finite everywhere, no
     # quarantines, outputs identical to the unchecked engine

@@ -112,6 +112,32 @@ def test_verify_rules():
     assert len(v) == 1 and "freed lane(s) [0]" in v[0].message
 
 
+def test_mixed_dispatch_rules():
+    """The fused mixed-mode edge: same shape as VERIFY (reads back in the
+    same step, so no outstanding depth), plus the freed-lane race check
+    must cover the packed prefill rows carried in meta, not just the
+    decode lanes."""
+    assert check_flat([
+        A("MIXED_DISPATCH", lanes=[0, 1], prefill_lanes=[2]),
+    ]) == []
+    v = check_flat(
+        [A("MIXED_DISPATCH", lanes=[0])], start_outstanding=1
+    )
+    assert len(v) == 1 and "MIXED_DISPATCH with 1 step(s)" in v[0].message
+    # a freed decode lane is caught ...
+    v = check_flat([
+        A("FINISH", lane=0, rid=1),
+        A("MIXED_DISPATCH", lanes=[0], prefill_lanes=[1]),
+    ])
+    assert len(v) == 1 and "freed lane(s) [0]" in v[0].message
+    # ... and so is a freed lane hiding among the prefill rows
+    v = check_flat([
+        A("FINISH", lane=2, rid=5),
+        A("MIXED_DISPATCH", lanes=[0], prefill_lanes=[2]),
+    ])
+    assert len(v) == 1 and "freed lane(s) [2]" in v[0].message
+
+
 def test_readback_rules():
     assert check_flat([A("READBACK", lag=1)], start_outstanding=1) == []
     v = check_flat([A("READBACK")])

@@ -218,7 +218,8 @@ def test_request_info_timing_survives_into_finished_records(done_engine):
 EXPECTED_SNAPSHOT_KEYS = {
     # dataclass counters
     "submitted", "admitted", "admit_blocked", "finished", "truncated",
-    "preemptions", "decode_steps", "prefill_tokens", "prefill_chunks",
+    "preemptions", "decode_steps", "engine_steps", "compute_dispatches",
+    "mixed_dispatches", "prefill_tokens", "prefill_chunks",
     "cached_tokens", "decode_steps_async", "lame_duck_tokens",
     "sync_fallbacks", "lane_syncs", "table_deltas", "h2d_uploads",
     "host_schedule_ms", "device_wait_ms", "tp_size", "kv_dtype",
@@ -244,7 +245,7 @@ EXPECTED_SNAPSHOT_KEYS = {
     "policy_table_id", "policy_table_stale", "policy_simulated_burn",
     # derived
     "prefix_skip_fraction", "accept_rate", "host_schedule_ms_per_step",
-    "device_wait_ms_per_step",
+    "device_wait_ms_per_step", "dispatches_per_step",
     # graftmeter derived
     "pad_waste_frac", "decode_pad_frac", "prefill_pad_frac",
     "achieved_flops_per_s", "mfu_est", "bandwidth_util_est",
@@ -296,6 +297,9 @@ def test_dashboard_renders_snapshot(done_engine):
     text = mod.render_snapshot(snap)
     assert "ttft" in text and "p50" in text
     assert f"finished {snap['finished']}" in text
+    # fused-step panel row: dispatches per engine step + the pmixed count
+    assert f"dispatch   {snap['dispatches_per_step']}/step" in text
+    assert f"mixed {snap['mixed_dispatches']})" in text
 
 
 # ---------------------------------------------------------------------------
