@@ -125,6 +125,8 @@ AUTOMATON: Tuple[Dict[str, str], ...] = (
          effect="lane joins the freed set"),
     dict(action="FINISH", guard="outstanding == 0",
          effect="lane joins the freed set"),
+    dict(action="RESTORE", guard="outstanding == 0; lanes not freed",
+         effect="- (spilled blocks upload into fresh pool ids)"),
     dict(action="AUDIT", guard="always legal", effect="-"),
 )
 
@@ -158,6 +160,12 @@ _HINTS = {
     "freed-lane": (
         "the lane was released (FINISH/PREEMPT) and not re-admitted; "
         "dispatching into it races host teardown against device writes"
+    ),
+    "restore-in-flight": (
+        "a tiered-KV restore scatters into freshly allocated pool blocks; "
+        "with a step in flight those allocations could recycle blocks "
+        "whose KV writes have not landed — restores ride the drained "
+        "admission wave only"
     ),
     "bookkeeping": (
         "the recorded trace is internally inconsistent — an emission "
@@ -278,6 +286,19 @@ def advance(state: ScheduleState, act: StepAction, where: str) -> List[Finding]:
         lane = meta.get("lane")
         if lane is not None:
             state.freed.add(lane)
+    elif t is ActionType.RESTORE:
+        if state.outstanding:
+            v.append(_finding(
+                "restore-in-flight", where,
+                f"RESTORE with {state.outstanding} step(s) in flight",
+            ))
+        hit = sorted(set(lanes) & state.freed)
+        if hit:
+            v.append(_finding(
+                "freed-lane", where,
+                f"restore into freed lane(s) {hit}",
+                detail=f"lanes={hit}",
+            ))
     elif t is ActionType.AUDIT:
         pass
     return v

@@ -42,7 +42,15 @@ from neuronx_distributed_llama3_2_tpu.serving.catalog import format_key
 # after harvest (the graftcheck GC009 completeness contract); the
 # remaining kinds only move bytes and report their element traffic
 COMPUTE_KINDS = frozenset({"pctx", "psfx", "pdecode", "pverify", "pmixed"})
-MOVE_KINDS = frozenset({"copy_block", "lane_set", "table_delta"})
+MOVE_KINDS = frozenset(
+    {"copy_block", "lane_set", "table_delta", "block_save", "block_restore"}
+)
+
+# PCIe-class host<->device link bandwidth the tiered-KV restore-vs-recompute
+# crossover prices payload moves against: sustained Gen4 x16-class figure,
+# not the marketing peak. The crossover compares restore bytes over this
+# link against prefill FLOPs at the padded rung (engine._restore_price).
+HOST_LINK_BW_BYTES_PER_S = 1.6e10
 
 
 @dataclasses.dataclass(frozen=True)
@@ -252,6 +260,16 @@ def analytic_cost(key: tuple, dims: EngineDims) -> Tuple[float, float, str]:
     elif kind == "table_delta":
         elems = dims.max_batch * dims.table_width
         return 1.0, float(2 * elems * 4), "analytic-move"
+    elif kind in ("block_save", "block_restore"):
+        # tiered KV: one block's payload crossing the pool boundary (spill
+        # snapshot out / restore scatter in). Scale tiles ride with the
+        # payload under quantized storage, so rows are priced at
+        # kv_row_bytes — these are the figures the restore-vs-recompute
+        # crossover divides by HOST_LINK_BW_BYTES_PER_S.
+        elems = 2 * dims.num_layers * dims.block_size \
+            * dims.kv_heads_local * dims.head_dim
+        byts = 2 * dims.block_size * dims.kv_row_bytes()
+        return float(elems), float(byts), "analytic-move"
     else:
         return 1.0, 1.0, "analytic-move"
     # compute-kind bytes: the parameter shard streams once, the touched

@@ -21,6 +21,14 @@ tracks ownership:
   > 1, or registered in the index) must first move the writer onto a
   private copy; :meth:`copy_on_write` does the ownership transfer and tells
   the caller whether to copy the pool rows.
+- **spill** — when a :class:`HostTier` is attached (``spill_enabled``), the
+  eviction victim's payload moves to host RAM instead of being discarded:
+  ``spill_hook`` (wired by the engine) snapshots the block D2H and the
+  radix index keeps the node alive in a *spilled* residency state, so a
+  later prefix hit restores the bytes instead of re-prefilling. The device
+  block still returns to the free list — spilled is the fourth lifecycle
+  state (free/active/cached/spilled), but only the first three occupy pool
+  ids.
 
 Block id 0 is reserved as the null block (padding writes) and never
 allocated.
@@ -29,9 +37,100 @@ allocated.
 from __future__ import annotations
 
 from collections import OrderedDict, deque
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 NULL_BLOCK = 0
+
+
+class HostTier:
+    """Byte-budgeted host-RAM LRU of spilled KV block payloads.
+
+    Entries are keyed by *spill id* (``sid``) — monotonic and never reused,
+    unlike pool block ids — and hold ``(payload, nbytes)`` where payload is
+    an opaque tuple of host arrays (k, v, and scale tiles when quantized).
+    Inserting past the byte budget evicts oldest-first, firing ``on_evict``
+    (wired to :meth:`..radix_index.RadixPrefixIndex.invalidate_spilled`) so
+    the trie drops the node whose bytes are gone. ``drop`` is the silent
+    reverse direction — the index discarding a spilled node tells the tier
+    to forget the payload *without* re-entering the index."""
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        on_evict: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        if budget_bytes <= 0:
+            raise ValueError("host tier needs a positive byte budget")
+        self.budget_bytes = int(budget_bytes)
+        self.on_evict = on_evict
+        self._entries: "OrderedDict[int, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+        self._next_sid = 0
+        self.evictions = 0
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    @property
+    def num_entries(self) -> int:
+        return len(self._entries)
+
+    def allocate_sid(self) -> int:
+        """A fresh spill id. Allocated when the spill is *enqueued* (before
+        the D2H drain lands) so the index can reference the in-flight
+        payload; never reused, so a stale sid can only miss."""
+        sid = self._next_sid
+        self._next_sid += 1
+        return sid
+
+    def put_at(self, sid: int, payload: Any, nbytes: int) -> None:
+        """Commit a drained payload under its pre-allocated sid, evicting
+        LRU entries past the byte budget (the new entry is MRU, so it is
+        only dropped when it alone exceeds the budget)."""
+        self._entries[sid] = (payload, int(nbytes))
+        self._bytes += int(nbytes)
+        while self._bytes > self.budget_bytes and self._entries:
+            victim, (_, vb) = self._entries.popitem(last=False)
+            self._bytes -= vb
+            self.evictions += 1
+            if self.on_evict is not None:
+                self.on_evict(victim)
+
+    def has(self, sid: int) -> bool:
+        return sid in self._entries
+
+    def get(self, sid: int) -> Optional[Any]:
+        """Peek a payload (LRU-touched) without removing it."""
+        ent = self._entries.get(sid)
+        if ent is None:
+            return None
+        self._entries.move_to_end(sid)
+        return ent[0]
+
+    def pop(self, sid: int) -> Optional[Any]:
+        """Take a payload out (restore path): the bytes move back to the
+        device pool, so the host copy is dropped."""
+        ent = self._entries.pop(sid, None)
+        if ent is None:
+            return None
+        self._bytes -= ent[1]
+        return ent[0]
+
+    def drop(self, sid: int) -> None:
+        """Forget a payload without firing ``on_evict`` (the index already
+        dropped the node; calling back in would recurse)."""
+        ent = self._entries.pop(sid, None)
+        if ent is not None:
+            self._bytes -= ent[1]
+
+    def stats(self) -> dict:
+        return {
+            "host_tier_bytes": self._bytes,
+            "host_tier_budget_bytes": self.budget_bytes,
+            "host_tier_entries": len(self._entries),
+            "host_tier_evictions": self.evictions,
+        }
 
 
 class AllocatorError(RuntimeError):
@@ -81,6 +180,13 @@ class BlockAllocator:
         # alloc() reports transient exhaustion without touching the pool —
         # drives the engine's back-off/preempt paths under a healthy pool
         self.fault_hook: Optional[Callable[[], bool]] = None
+        # spill seam (engine wires both when spill_enabled): the hook gets
+        # the eviction victim's id and returns True when it moved the
+        # payload to the host tier — the index then keeps the node alive in
+        # its spilled state, so the subtree below it stays reachable and
+        # on_evict is NOT fired
+        self.spill_hook: Optional[Callable[[int], bool]] = None
+        self.host_tier: Optional[HostTier] = None
 
     # -- introspection ----------------------------------------------------
 
@@ -116,7 +222,7 @@ class BlockAllocator:
         return bid in self._registered
 
     def stats(self) -> dict:
-        return {
+        rec = {
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
             "active_blocks": self.active_blocks,
@@ -125,7 +231,16 @@ class BlockAllocator:
             "block_utilization": round(self.utilization(), 4),
             "evictions": self.evictions,
             "cow_copies": self.cow_copies,
+            # host-tier keys are always present (zero when no tier is
+            # attached) so the metrics snapshot keeps a stable key set
+            "host_tier_bytes": 0,
+            "host_tier_budget_bytes": 0,
+            "host_tier_entries": 0,
+            "host_tier_evictions": 0,
         }
+        if self.host_tier is not None:
+            rec.update(self.host_tier.stats())
+        return rec
 
     def leak_check(self) -> List[int]:
         """Block ids violating the pool partition invariant. Every usable id
@@ -218,6 +333,14 @@ class BlockAllocator:
 
     def _evict_one(self) -> None:
         bid, _ = self._cached.popitem(last=False)  # LRU victim
+        if self.spill_hook is not None and self.spill_hook(bid):
+            # payload moved to the host tier and the index marked the node
+            # spilled — the subtree below it stays reachable, so no
+            # on_evict cascade; only the victim's device id is recycled
+            self._registered.discard(bid)
+            self._free.append(bid)
+            self.evictions += 1
+            return
         dropped = [bid]
         if self.on_evict is not None:
             dropped.extend(self.on_evict(bid))

@@ -158,6 +158,10 @@ class CatalogManifest:
     # the psfx keys leave the universe entirely and the mixed_t × kv
     # ladder replaces the psfx suffix-pair product (the GC007 shrink)
     fused_step: bool = False
+    # PagedConfig.spill_enabled: the tiered-KV host spill tier adds the
+    # block_save/block_restore move programs to the universe (and only
+    # then — registering them on a spill-free engine is a GC007 finding)
+    spill: bool = False
 
     @classmethod
     def from_engine(cls, engine: Any) -> "CatalogManifest":
@@ -188,6 +192,7 @@ class CatalogManifest:
             checked=bool(getattr(engine, "_check_logits", False)),
             gather_variants=bool(engine.paged.degrade_after_faults),
             fused_step=bool(getattr(engine, "_fused_step", False)),
+            spill=bool(getattr(engine, "_spill", False)),
         )
 
     def _expand(self, gathers: Tuple[bool, ...]) -> List[tuple]:
@@ -197,6 +202,9 @@ class CatalogManifest:
             ("lane_set",),
             ("table_delta",),
         ]
+        if self.spill:
+            keys.append(("block_save", self.quantized))
+            keys.append(("block_restore", self.quantized))
         for g in gathers:
             for b in lad.prefill_buckets:
                 keys.append(("pctx", b, cfg, g))
@@ -241,6 +249,8 @@ class CatalogManifest:
         ) if on]
         if self.fused_step:
             flags.append("fused-step")
+        if self.spill:
+            flags.append("spill")
         return (
             f"B={lad.decode_batch} prefill={list(lad.prefill_buckets)} "
             f"kv={list(lad.kv_buckets)} verify_t={list(lad.verify_t)} "
@@ -321,7 +331,7 @@ def format_key(key: tuple) -> str:
     elif kind == "pmixed":
         _, t, kv, cfg, gather, checked = key
         bits = [f"t={t}", f"kv_limit={kv}", f"cfg={_format_sampling(cfg)}"]
-    elif kind == "copy_block":
+    elif kind in ("copy_block", "block_save", "block_restore"):
         bits = [f"quantized={key[1]}"]
     else:  # lane_set / table_delta / future kinds: render fields raw
         bits = [str(f) for f in key[1:]]

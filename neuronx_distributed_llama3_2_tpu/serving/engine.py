@@ -69,6 +69,7 @@ from neuronx_distributed_llama3_2_tpu.inference.sampling import (
 from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     NULL_BLOCK,
     BlockAllocator,
+    HostTier,
 )
 from neuronx_distributed_llama3_2_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_llama3_2_tpu.serving.policy import (
@@ -81,6 +82,7 @@ from neuronx_distributed_llama3_2_tpu.serving.policy import (
 )
 from neuronx_distributed_llama3_2_tpu.serving.slo import SLOMonitor, SLOPolicy
 from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
+    SPILLED_BLOCK,
     RadixPrefixIndex,
 )
 from neuronx_distributed_llama3_2_tpu.serving.tracing import (
@@ -155,6 +157,27 @@ class PagedConfig:
     # prompt before it is admitted, delaying the first preemption
     decode_reserve_blocks: int = 2
     enable_prefix_caching: bool = True
+    # -- tiered KV storage (docs/serving.md "Tiered KV storage") --
+    # spill eviction victims' payloads into a host-RAM tier behind the
+    # radix index instead of discarding them: the trie node survives in a
+    # `spilled` residency state and a later prefix hit restores the blocks
+    # H2D (metered, never on the steady-state path) when the cost model
+    # says the transfer beats re-prefilling. Requires
+    # enable_prefix_caching and a positive host_tier_bytes.
+    spill_enabled: bool = False
+    # byte budget of the host tier; its own LRU evicts past it (dropping
+    # the spilled trie nodes whose payloads are gone)
+    host_tier_bytes: int = 0
+    # restore-vs-recompute crossover: restore a spilled run when
+    # restore_seconds <= restore_crossover * recompute_seconds, priced from
+    # graftmeter CostProfiles (payload bytes over a PCIe-class host link vs
+    # prefill FLOPs at the padded rung). 1.0 = break-even; large values
+    # force restoring (tiny-model test harnesses, where prefill is nearly
+    # free); 0 declines every restore while still spilling.
+    restore_crossover: float = 1.0
+    # bound on enqueued-but-undrained D2H spill snapshots; the oldest
+    # entries drain early (blocking) when the queue tops out
+    spill_queue_depth: int = 8
     cache_dtype: Any = None
     # quantized KV pool (docs/serving.md "Quantized KV pool"): store the
     # block pool int8/fp8 with per-(row, kv-head) absmax scales and dequant
@@ -562,6 +585,33 @@ class PagedServingEngine:
                 )
         self.allocator = BlockAllocator(paged.num_blocks, bs)
         self.index = RadixPrefixIndex(self.allocator)
+        # tiered KV storage (docs/serving.md "Tiered KV storage"): the
+        # host-RAM spill tier behind the radix index. _spill MUST be set
+        # before the catalog is built below — spill adds the
+        # block_save/block_restore move keys to the legal key universe
+        # (graftcheck GC007).
+        self._spill = bool(paged.spill_enabled)
+        self.host_tier: Optional[HostTier] = None
+        # enqueued-but-undrained D2H snapshots: (sid, device arrays, nbytes)
+        self._spill_pending: deque = deque()
+        self._restore_dims = None  # cached EngineDims for restore pricing
+        if self._spill:
+            if not paged.enable_prefix_caching:
+                raise ValueError(
+                    "spill_enabled requires enable_prefix_caching (the "
+                    "spilled residency state lives in the radix index)"
+                )
+            if paged.host_tier_bytes <= 0:
+                raise ValueError(
+                    "spill_enabled requires a positive host_tier_bytes"
+                )
+            self.host_tier = HostTier(
+                paged.host_tier_bytes,
+                on_evict=self.index.invalidate_spilled,
+            )
+            self.allocator.host_tier = self.host_tier
+            self.allocator.spill_hook = self._spill_block
+            self.index.on_spill_drop = self._drop_spill_payload
         self.metrics = ServingMetrics()
         # graftscope flight recorder (serving/tracing.py): always
         # constructed — every hook is a no-op attribute test when
@@ -715,6 +765,47 @@ class PagedServingEngine:
             ("copy_block", self._kv_quantized), _copy_block,
             donate_argnums=(0,), kind="copy_block",
         )
+        # tiered-KV spill programs, registered only when spill is on (the
+        # registry must stay inside the catalog's key universe — GC007).
+        # block_save slices one block's payload out of the pool: a pure
+        # read, NOT donated, so its snapshot buffers stay valid after the
+        # allocator reuses the id. block_restore scatters an uploaded
+        # payload into a freshly allocated block, donating the pool like
+        # copy_block does.
+        self._block_save_fn = None
+        self._block_restore_fn = None
+        if self._spill:
+            if self._kv_quantized:
+                # scale tiles ARE part of the block's value under quantized
+                # storage — they spill and restore with the payload
+                def _block_save(c, b):
+                    return (c.k[:, b], c.v[:, b],
+                            c.k_scale[:, b], c.v_scale[:, b])
+
+                def _block_restore(c, b, k, v, ks, vs):
+                    return type(c)(
+                        k=c.k.at[:, b].set(k),
+                        v=c.v.at[:, b].set(v),
+                        k_scale=c.k_scale.at[:, b].set(ks),
+                        v_scale=c.v_scale.at[:, b].set(vs),
+                    )
+            else:
+                def _block_save(c, b):
+                    return (c.k[:, b], c.v[:, b])
+
+                def _block_restore(c, b, k, v):
+                    return type(c)(
+                        k=c.k.at[:, b].set(k),
+                        v=c.v.at[:, b].set(v),
+                    )
+            self._block_save_fn = self._register_program(
+                ("block_save", self._kv_quantized), _block_save,
+                kind="block_save",
+            )
+            self._block_restore_fn = self._register_program(
+                ("block_restore", self._kv_quantized), _block_restore,
+                donate_argnums=(0,), kind="block_restore",
+            )
         # graftmeter device-cost ledger (serving/accounting.py): filled by
         # ensure_cost_profiles() — automatically at the end of prewarm()
         # when cost_accounting is on. _flops_by_key caches (flops, bytes)
@@ -1786,6 +1877,15 @@ class PagedServingEngine:
                         self._d_tables, zero, zero,
                         jnp.asarray(NULL_BLOCK, jnp.int32),
                     )
+                elif kind == "block_save":
+                    # slice the null block out; the snapshot is discarded
+                    self._block_save_fn(self.cache, zero)
+                elif kind == "block_restore":
+                    # scatter an all-zeros payload into the null block at
+                    # exactly traffic's upload shapes/dtypes
+                    self.cache = self._block_restore_fn(
+                        self.cache, zero, *self._null_block_payload()
+                    )
                 elif kind == "pctx":
                     _, bucket, cfg, _g = key_
                     fn = self._prefill_ctx_program(bucket, cfg)
@@ -2086,6 +2186,206 @@ class PagedServingEngine:
                 waiting=len(self._queue),
             )
 
+    # -- tiered KV storage (docs/serving.md "Tiered KV storage") -----------
+
+    def _null_block_payload(self) -> tuple:
+        """Aval twins of a restore's uploaded payload arrays (one block's
+        k/v slices, plus scale tiles when quantized): plain ``jnp`` zeros,
+        so prewarm's ``block_restore`` dispatch traces at exactly traffic's
+        shapes/dtypes without touching the ``h2d_uploads`` counter."""
+        c = self.cache
+        ks = c.k.shape  # (L, num_blocks, block_size, NKV_local, D)
+        shape = (ks[0], ks[2], ks[3], ks[4])
+        out = [jnp.zeros(shape, c.k.dtype), jnp.zeros(shape, c.v.dtype)]
+        if self._kv_quantized:
+            ss = c.k_scale.shape  # (L, num_blocks, block_size, NKV_local)
+            sshape = (ss[0], ss[2], ss[3])
+            out.append(jnp.zeros(sshape, c.k_scale.dtype))
+            out.append(jnp.zeros(sshape, c.v_scale.dtype))
+        return tuple(out)
+
+    def _spill_block(self, bid: int) -> bool:
+        """``BlockAllocator.spill_hook``: move the eviction victim's
+        payload toward host RAM instead of discarding it. The block_save
+        program slices a fresh snapshot out of the pool (pure read, not
+        donated — the buffers stay valid after the allocator reuses the
+        id; dispatched in stream order, so any in-flight decode writes are
+        already reflected), the radix node flips to its spilled residency
+        state, and the snapshot joins the bounded background drain queue —
+        the blocking D2H copy happens at drain time, off the dispatch
+        path. The bid rides as a plain control scalar (the copy_block
+        precedent), not a counted upload. False = no index node to retain;
+        the allocator falls through to the normal discard path."""
+        if self._block_save_fn is None or bid not in self.index._by_block:
+            return False
+        out = self._block_save_fn(self.cache, jnp.asarray(bid, jnp.int32))
+        nbytes = sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize for a in out
+        )
+        sid = self.host_tier.allocate_sid()
+        self.index.mark_spilled(bid, sid)
+        self._spill_pending.append((sid, out, nbytes))
+        self.metrics.blocks_spilled += 1
+        # bounded queue: past the depth, the oldest snapshot drains early
+        while len(self._spill_pending) > self.paged.spill_queue_depth:
+            self._drain_one_spill()
+        return True
+
+    def _drain_one_spill(self) -> None:
+        sid, out, nbytes = self._spill_pending.popleft()
+        if sid not in self.index._spilled:
+            return  # node dropped while the snapshot waited; forget it
+        payload = tuple(np.asarray(a) for a in out)  # blocking D2H copy
+        self.host_tier.put_at(sid, payload, nbytes)
+        self.metrics.spill_bytes += nbytes
+
+    def _drain_spills(self) -> None:
+        """Commit every enqueued spill snapshot to the host tier. Called
+        at the end of :meth:`step` (the background drain — device work for
+        the step is already in flight, so the D2H wait overlaps it) and
+        before a restore prices a spilled run."""
+        if not self._spill_pending:
+            return
+        t0 = time.perf_counter()
+        n = len(self._spill_pending)
+        while self._spill_pending:
+            self._drain_one_spill()
+        if self.tracer.enabled:
+            self.tracer.complete(
+                "spill_drain", t0, time.perf_counter(), blocks=n
+            )
+
+    def _drop_spill_payload(self, sid: int) -> None:
+        """``RadixPrefixIndex.on_spill_drop``: forget a spilled payload in
+        both places it can live — the host tier and the not-yet-drained
+        snapshot queue."""
+        if self.host_tier is not None:
+            self.host_tier.drop(sid)
+        if self._spill_pending:
+            self._spill_pending = deque(
+                e for e in self._spill_pending if e[0] != sid
+            )
+
+    def _restore_price(self, n_bytes: int, gain: int) -> Tuple[float, float]:
+        """``(restore_seconds, recompute_seconds)`` for a spilled run:
+        payload bytes over the PCIe-class host link vs prefill FLOPs at
+        the padded rung — from the harvested CostProfiles when graftmeter
+        ran (``PagedConfig.cost_accounting``), the same analytic formulas
+        otherwise."""
+        from neuronx_distributed_llama3_2_tpu.serving.accounting import (
+            HOST_LINK_BW_BYTES_PER_S,
+            EngineDims,
+            analytic_cost,
+        )
+
+        restore_s = n_bytes / HOST_LINK_BW_BYTES_PER_S
+        bucket = pick_bucket(self._prefill_buckets, max(gain, 1))
+        flops = None
+        if self.cost_profiles:
+            for k, p in self.cost_profiles.items():
+                if k[0] == "pctx" and int(k[1]) == bucket:
+                    flops = p.flops
+                    break
+        if flops is None:
+            if self._restore_dims is None:
+                self._restore_dims = EngineDims.from_engine(self)
+            flops = analytic_cost(("pctx", bucket), self._restore_dims)[0]
+        peak = self.metrics.peak_flops_per_chip * max(
+            self.metrics.tp_size, 1
+        )
+        return restore_s, flops / max(peak, 1.0)
+
+    def _maybe_restore(
+        self, seq: List[int], matched: int, mblocks: List[int]
+    ) -> Tuple[int, List[int]]:
+        """Restore-over-recompute at admission: when the radix walk
+        extends past the resident prefix into spilled nodes, price the
+        spilled run and — when restoring wins — upload the payloads
+        through the metered ``_upload`` funnel into freshly allocated
+        blocks, heal the nodes back to resident, and hand the extended
+        match to the admission. Restores ride admission (where prefill
+        uploads already live), never the steady-state dispatch path. An
+        injected host-tier fault (or a payload lost to the tier's budget)
+        drops the spilled run inside its own failure domain and falls
+        back to re-prefilling; resident survivors are untouched."""
+        ext_matched, chain = self.index.walk(seq)
+        spilled = [n for n in chain if n.block == SPILLED_BLOCK]
+        gain = ext_matched - matched
+        if not spilled or gain <= 0:
+            return matched, mblocks
+        self._drain_spills()  # payloads must be host-resident to price
+        if self.injector is not None and self.injector.host_tier_fault():
+            # corrupt/evict the victim before restore: the shallowest
+            # spilled node's subtree (the whole spilled run) is the
+            # failure domain — drop it and re-prefill
+            self.index.invalidate_spilled(spilled[0].sid)
+            self.metrics.restore_fallbacks += 1
+            return matched, mblocks
+        payloads = []
+        for node in spilled:
+            p = self.host_tier.get(node.sid)
+            if p is None:
+                # budget eviction raced the walk; nothing to restore from
+                self.metrics.restore_fallbacks += 1
+                return matched, mblocks
+            payloads.append(p)
+        total_bytes = sum(a.nbytes for p in payloads for a in p)
+        restore_s, recompute_s = self._restore_price(total_bytes, gain)
+        xo = self.paged.restore_crossover
+        alloc = self.allocator
+        if (
+            xo <= 0
+            or restore_s > xo * recompute_s
+            or alloc.available() < len(spilled) + 1
+        ):
+            self.metrics.restore_declined += 1
+            return matched, mblocks
+        t0 = time.perf_counter()
+        # hold the chain's resident blocks so our own allocations cannot
+        # evict them mid-restore; restored blocks join the held list and
+        # everything is released (-> parked cached) once the chain heals
+        held: List[int] = []
+        for node in chain:
+            if node.block >= 0:
+                alloc.incref(node.block)
+                held.append(node.block)
+        ok = True
+        n_restored = 0
+        for node, payload in zip(spilled, payloads):
+            if node.sid not in self.index._spilled:
+                ok = False
+                break
+            nb = alloc.alloc()
+            if nb is None:
+                ok = False
+                break
+            args = tuple(self._upload(a, a.dtype) for a in payload)
+            self.metrics.restore_uploads += len(args)
+            self.cache = self._block_restore_fn(
+                self.cache, jnp.asarray(nb, jnp.int32), *args
+            )
+            self.index.heal(node, nb)  # drops the host payload too
+            held.append(nb)
+            n_restored += 1
+        for b in held:
+            alloc.release(b)
+        if not ok:
+            self.metrics.restore_fallbacks += 1
+            return matched, mblocks
+        self.metrics.blocks_restored += n_restored
+        self.metrics.restore_hits += 1
+        self.metrics.restore_bytes += total_bytes
+        self.index.hit_tokens += gain  # restored tokens ARE prefix hits
+        if self.tracer.enabled:
+            self.tracer.complete(
+                "restore", t0, time.perf_counter(),
+                blocks=n_restored, bytes=total_bytes, tokens=gain,
+            )
+        self._emit_action(
+            ActionType.RESTORE, lanes=[], blocks=n_restored, tokens=gain,
+        )
+        return ext_matched, [n.block for n in chain]
+
     def _admit_wave(self) -> None:
         bs = self.paged.block_size
         alloc = self.allocator
@@ -2094,6 +2394,13 @@ class PagedServingEngine:
             seq = req.prompt + req.out  # resume re-prefills generated tokens
             if self.paged.enable_prefix_caching:
                 matched, mblocks = self.index.match(seq)
+                if self._spill and self.index.num_spilled:
+                    # tiered KV: the walk may extend past the resident
+                    # prefix into spilled nodes — restore them H2D when
+                    # the cost model says the bytes beat re-prefilling
+                    matched, mblocks = self._maybe_restore(
+                        seq, matched, mblocks
+                    )
             else:
                 matched, mblocks = 0, []
             # always leave >= 1 token to prefill: the admission forward must
@@ -3306,6 +3613,12 @@ class PagedServingEngine:
             alive = self._step_inner()
         except InjectedFault as fault:
             alive = self._recover_fault(fault)
+        if self._spill_pending:
+            # tiered KV: commit this step's spill snapshots to the host
+            # tier — the step's device work is already in flight, so the
+            # blocking D2H copies overlap it; nothing here dispatches or
+            # uploads (GC003's zero-upload steady state holds)
+            self._drain_spills()
         if self.injector is not None:
             self.metrics.faults_injected = self.injector.total_fired
         total_ms = (time.perf_counter() - t0) * 1e3

@@ -29,6 +29,10 @@ Fault classes (the taxonomy the engine recovers from):
   draft trimming, and preempt-requeue under a healthy pool.
 - ``latency`` — a host<->device transfer stalls (``time.sleep``),
   exercising the watchdog's tolerance for slow-but-progressing steps.
+- ``host_tier`` — a spilled KV block's host payload is corrupted/evicted
+  before its restore (tiered KV storage, docs/serving.md). The engine
+  drops the spilled run inside its own failure domain and falls back to
+  re-prefilling; every other request's tokens stay byte-identical.
 
 Determinism: all randomness comes from one ``np.random.default_rng(seed)``
 consumed in engine-call order, so a chaos run is exactly reproducible
@@ -44,7 +48,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-FAULT_KINDS = ("device", "nan", "drafter", "alloc", "latency")
+FAULT_KINDS = ("device", "nan", "drafter", "alloc", "latency", "host_tier")
 
 
 class InjectedFault(RuntimeError):
@@ -96,6 +100,7 @@ class FaultPlan:
     alloc_rate: float = 0.0    # per BlockAllocator.alloc call
     latency_rate: float = 0.0  # per host<->device transfer funnel hit
     latency_ms: float = 1.0    # injected sleep per latency fault
+    host_tier_rate: float = 0.0  # per tiered-KV restore attempt
     schedule: Tuple[Tuple[int, str], ...] = ()
 
     def __post_init__(self):
@@ -200,3 +205,12 @@ class FaultInjector:
         if self._fires("latency", self.plan.latency_rate):
             self._record("latency", site, ())
             time.sleep(self.plan.latency_ms / 1e3)
+
+    def host_tier_fault(self) -> bool:
+        """True = corrupt/evict the spilled run this restore attempt was
+        about to pull from the host tier. The engine invalidates the run
+        (its own failure domain) and falls back to re-prefilling."""
+        if self._fires("host_tier", self.plan.host_tier_rate):
+            self._record("host_tier", "restore", ())
+            return True
+        return False

@@ -44,6 +44,15 @@ Invariants checked:
    carry the installed GenerationConfig params and their request's
    SeedSequence-derived base key (the preempt-resume replay contract).
    Without the knob, all four residents are None.
+9. Spilled residency — with ``PagedConfig.spill_enabled`` every node in
+   the radix index's spilled set carries the ``SPILLED_BLOCK`` sentinel
+   (never a live pool id), round-trips through its sid key, keeps a
+   consistent parent link, and has its payload *somewhere*: resident in
+   the host tier or still queued in the engine's D2H drain. The host
+   tier's resident bytes respect its budget. Without the knob, the
+   spilled set, the pending queue, and the host tier are all empty/None
+   (pool conservation across all four residency states — free, active,
+   cached, spilled — is checks 1 + 9 together).
 """
 
 from __future__ import annotations
@@ -54,6 +63,9 @@ import numpy as np
 
 from neuronx_distributed_llama3_2_tpu.serving.block_allocator import (
     NULL_BLOCK,
+)
+from neuronx_distributed_llama3_2_tpu.serving.radix_index import (
+    SPILLED_BLOCK,
 )
 
 
@@ -205,6 +217,44 @@ def audit_engine(engine) -> List[str]:
             f"kv_cache_dtype={engine.paged.kv_cache_dtype!r} but cache "
             f"scale arrays present=(k={has_k}, v={has_v})"
         )
+
+    # 9. spilled residency (checked before 8: that one early-returns)
+    tier = getattr(engine, "host_tier", None)
+    spilled = getattr(index, "_spilled", {})
+    pending_sids = {e[0] for e in getattr(engine, "_spill_pending", ())}
+    if not getattr(engine, "_spill", False):
+        if spilled:
+            v.append(
+                f"{len(spilled)} spilled radix node(s) without spill_enabled"
+            )
+        if pending_sids:
+            v.append("spill drain queue non-empty without spill_enabled")
+        if tier is not None:
+            v.append("host tier present without spill_enabled")
+    else:
+        for sid, node in spilled.items():
+            if node.block != SPILLED_BLOCK:
+                v.append(
+                    f"spilled node sid {sid}: block {node.block} != "
+                    "SPILLED_BLOCK sentinel"
+                )
+            if node.sid != sid:
+                v.append(f"spilled node sid {sid}: claims sid {node.sid}")
+            if (
+                node.parent is not None
+                and node.parent.children.get(node.key) is not node
+            ):
+                v.append(f"spilled node sid {sid}: broken parent link")
+            if not tier.has(sid) and sid not in pending_sids:
+                v.append(
+                    f"spilled node sid {sid}: payload neither resident in "
+                    "the host tier nor queued for drain"
+                )
+        if tier.resident_bytes > tier.budget_bytes:
+            v.append(
+                f"host tier over budget: {tier.resident_bytes} > "
+                f"{tier.budget_bytes} bytes"
+            )
 
     # 8. fused-sampling residents match the on_device_sampling knob
     residents = {

@@ -83,6 +83,18 @@ class ServingMetrics:
     lane_syncs: int = 0          # full-lane host→device resident-state pushes
     table_deltas: int = 0        # single-entry block-table scatter updates
     h2d_uploads: int = 0         # host→device array uploads on the serving path
+    # -- tiered KV storage (docs/serving.md "Tiered KV storage"): spill
+    #    victims move D2H into the host tier and prefix hits on spilled
+    #    runs restore H2D through the metered _upload funnel (the
+    #    restore_uploads share of h2d_uploads) instead of re-prefilling --
+    blocks_spilled: int = 0      # eviction victims snapshotted to host RAM
+    blocks_restored: int = 0     # spilled blocks scattered back into the pool
+    spill_bytes: int = 0         # payload bytes drained D2H
+    restore_bytes: int = 0       # payload bytes uploaded H2D on restores
+    restore_hits: int = 0        # admissions whose spilled run restored
+    restore_fallbacks: int = 0   # restores abandoned (fault / payload lost)
+    restore_declined: int = 0    # spilled runs re-prefilled by the crossover
+    restore_uploads: int = 0     # h2d_uploads attributable to restores
     # -- on-device sampling (docs/serving.md "On-device sampling") --
     sampled_steps: int = 0         # decode/verify dispatches drawing in-fuse
     host_sample_fallbacks: int = 0  # sampled dispatches that paid the host
@@ -372,11 +384,19 @@ class ServingMetrics:
             self.compute_dispatches / max(self.engine_steps, 1), 4)
         for key, field_name in _HIST_KEYS.items():
             rec[key] = getattr(self, field_name).snapshot()
+        # tiered-KV derived gauge: of the admissions that reached a spilled
+        # run, the fraction whose restore went through
+        attempts = (
+            self.restore_hits + self.restore_fallbacks + self.restore_declined
+        )
+        rec["restore_hit_rate"] = round(
+            self.restore_hits / attempts, 4) if attempts else 0.0
         if allocator is not None:
             rec.update(allocator.stats())
         if index is not None:
             rec["prefix_hit_rate"] = round(index.hit_rate(), 4)
             rec["radix_nodes"] = index.num_nodes
+            rec["spilled_nodes"] = getattr(index, "num_spilled", 0)
         return rec
 
     def prometheus(

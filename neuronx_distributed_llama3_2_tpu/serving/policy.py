@@ -34,12 +34,12 @@ class ActionType(enum.Enum):
     """The step-action alphabet (docs/static_analysis.md "graftsched").
 
     The first six are *policy-schedulable*: a StepPolicy may yield them.
-    The last four are *engine-emitted only* — they record transitions the
+    The last five are *engine-emitted only* — they record transitions the
     engine performs as consequences of scheduled actions (a finish
     discovered by a readback, a preemption forced by pool pressure, the
-    resident flushes that precede a dispatch) and appear in the action
-    trace for the legality automaton, but a policy yielding one is an
-    error."""
+    resident flushes that precede a dispatch, a tiered-KV restore decided
+    inside an admission wave) and appear in the action trace for the
+    legality automaton, but a policy yielding one is an error."""
 
     ADMIT = "ADMIT"                        # admission wave (+ inline prefill)
     PREFILL_CHUNK = "PREFILL_CHUNK"        # one chunk per prefilling lane
@@ -52,6 +52,7 @@ class ActionType(enum.Enum):
     FINISH = "FINISH"                      # engine-emitted: lane released
     LANE_SET_FLUSH = "LANE_SET_FLUSH"      # engine-emitted: full-lane sync
     TABLE_DELTA_FLUSH = "TABLE_DELTA_FLUSH"  # engine-emitted: 1-entry delta
+    RESTORE = "RESTORE"                    # engine-emitted: spilled blocks H2D
 
 
 #: Actions a StepPolicy is allowed to yield from :meth:`StepPolicy.actions`.

@@ -4,9 +4,16 @@ Drives a seeded randomized arrival schedule through the engine twice —
 once fault-free (the greedy baseline), once under a chaos
 :class:`~neuronx_distributed_llama3_2_tpu.serving.FaultInjector` firing
 every fault class (device errors, NaN logits, drafter bugs, transient
-alloc failures, transfer latency) — with every serving feature on: async
-lookahead, speculation, chunked prefill, a pool tight enough to preempt,
-periodic strict invariant audits, the degradation ladder.
+alloc failures, transfer latency, host-tier corruption) — with every
+serving feature on: async lookahead, speculation, chunked prefill, a
+pool tight enough to preempt, tiered KV spill (both runs — a third of
+the prompts share a system prefix so the tight pool keeps spilling and
+restoring it, giving the ``host_tier`` fault restore attempts to
+corrupt), periodic strict invariant audits, the degradation ladder. A
+host-tier fault is absorbed like a drafter bug: the spilled run is
+invalidated inside its own failure domain and the request re-prefills,
+so the parity gate below also proves restore-fallback changes no
+tokens.
 
 Gates (record still prints on failure, like kv_block_bench.py):
 
@@ -55,6 +62,8 @@ def build_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--drafter-rate", type=float, default=0.05)
     ap.add_argument("--alloc-rate", type=float, default=0.02)
     ap.add_argument("--latency-rate", type=float, default=0.05)
+    ap.add_argument("--host-tier-rate", type=float, default=0.2,
+                    help="per-restore-attempt host-tier corruption rate")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="virtual CPU mesh (testing only)")
     ap.add_argument("--trace-dir", default=os.environ.get("SERVING_TRACE_DIR"),
@@ -101,9 +110,22 @@ def run_bench(args: argparse.Namespace) -> dict:
 
     rng = np.random.default_rng(args.seed)
     lengths = rng.integers(3, 32, size=args.requests)
+    # cycled system prefixes (3 blocks each at the default block_size=4):
+    # the reuse distance plus the tight pool evicts each one between its
+    # uses, so the spill tier keeps restoring them — the host_tier fault
+    # class needs those restore attempts
+    shared = [
+        rng.integers(0, config.vocab_size, size=(12,)).tolist()
+        for _ in range(4)
+    ]
     prompts = []
     for i, n in enumerate(lengths):
-        if i % 2 == 0:  # repetitive half so speculation engages
+        if i % 2 == 1:  # prefix-sharing half so spill/restore engages
+            prompts.append(
+                shared[i % 4]
+                + rng.integers(0, config.vocab_size, size=(int(n),)).tolist()
+            )
+        elif i % 2 == 0:  # repetitive half so speculation engages
             pat = rng.integers(1, 9, size=3).tolist()
             prompts.append((pat * (int(n) // 3 + 1))[: int(n)])
         else:
@@ -117,6 +139,9 @@ def run_bench(args: argparse.Namespace) -> dict:
     paged_cfg = PagedConfig(
         block_size=args.block_size, num_blocks=args.num_blocks,
         decode_reserve_blocks=1, prefill_chunk_tokens=8, async_loop=True,
+        # spill on BOTH runs (parity compares spill-vs-spill); crossover
+        # forced sky-high because tiny-model prefill FLOPs are ~free
+        spill_enabled=True, host_tier_bytes=1 << 30, restore_crossover=1e9,
         spec_draft_tokens=4, stall_step_limit=500, audit_interval=8,
         audit_debug=True, degrade_after_faults=3, degrade_window_steps=32,
         degrade_recover_steps=16,
@@ -130,9 +155,10 @@ def run_bench(args: argparse.Namespace) -> dict:
         seed=args.fault_seed,
         drafter_rate=args.drafter_rate, alloc_rate=args.alloc_rate,
         latency_rate=args.latency_rate, latency_ms=0.1,
+        host_tier_rate=args.host_tier_rate,
         schedule=(
             (5, "device"), (15, "nan"), (20, "drafter"),
-            (25, "alloc"), (30, "latency"),
+            (25, "alloc"), (30, "latency"), (0, "host_tier"),
         ),
     )
 

@@ -91,7 +91,15 @@ STAGES = [
     # steps/sec A/B (all parity-gated; timings recorded)
     ("paged_decode",
      [PY, os.path.join(REPO, "scripts", "paged_decode_bench.py")], 1200),
-    # chaos soak: every fault class against the full-featured serving
+    # paged KV + tiered-KV spill: the prefix-sharing acceptance workload
+    # plus the spill-vs-recompute churn leg (restore hit rate > 0,
+    # byte-identical outputs, tokens/step no worse — the restore-over-
+    # recompute acceptance bar on real chip bandwidth, where the PCIe-
+    # class restore-vs-prefill crossover is actually priced)
+    ("kv_spill",
+     [PY, os.path.join(REPO, "scripts", "kv_block_bench.py")], 900),
+    # chaos soak: every fault class (now including host_tier corruption
+    # against the spill-enabled engine) against the full-featured serving
     # engine, gated on parity-of-unaffected-requests + zero leaks + clean
     # invariant audits (scripts/chaos_soak.py; fast CPU smoke in tier-1)
     ("chaos_soak",

@@ -245,6 +245,41 @@ def _catalog_fused_engine(prewarm=True):
     )
 
 
+def _catalog_spill_engine(prewarm=True):
+    """Tiered-KV twin of the catalog-int8 engine: same strict knob set
+    plus ``spill_enabled`` over a deliberately small pool, so the churn
+    drive below actually evicts through the D2H spill path and restores
+    on the prefix re-hit. ``restore_crossover`` is forced sky-high
+    because tiny-model prefill FLOPs are nearly free — the gate is about
+    the program/catalog contract (GC007: block_save/block_restore in the
+    manifest iff spill), not the pricing policy."""
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    cfg, params = _tiny()
+    return PagedServingEngine(
+        InferenceEngine(
+            cfg, params, max_batch=4, max_seq_len=64, buckets=[8, 16]
+        ),
+        GenerationConfig(max_new_tokens=6),
+        PagedConfig(
+            block_size=8, num_blocks=16, kv_cache_dtype="int8",
+            quant_mxu=True, on_device_sampling=True,
+            spec_draft_tokens=4, prefill_chunk_tokens=6, async_loop=True,
+            spill_enabled=True, host_tier_bytes=1 << 30,
+            restore_crossover=1e9,
+            trace_enabled=True, trace_buffer_steps=64, prewarm=prewarm,
+        ),
+        precompile=False,
+    )
+
+
 def _catalog_tp2_engine(prewarm=True):
     """tp=2 catalog twin (caller owns the mesh): bf16 pool, chunked
     prefill, single-bucket ladder — small enough that the 9-key manifest
@@ -468,6 +503,51 @@ def entry_catalog_fused():
     )
 
 
+def entry_catalog_spill():
+    """The spill_enabled twin: GC001-GC010 over a registry that carries
+    the block_save/block_restore movement programs, byte-identity against
+    its own golden entry, and a churn drive that proves the tiered-KV
+    path end to end — blocks spill D2H during eviction pressure, a
+    prefix re-hit restores H2D instead of re-prefilling, the recorded
+    action trace replays RESTORE edges through graftsched's automaton,
+    and the D2H drain adds zero steady-state compiles or unmetered
+    uploads (every restore upload is accounted in ``restore_uploads``)."""
+    engine = _catalog_spill_engine()
+    cfg, _ = _tiny()
+    rng = np.random.default_rng(11)
+    shared = rng.integers(0, cfg.vocab_size, size=(16,)).tolist()
+    tail = lambda n: rng.integers(0, cfg.vocab_size, size=(n,)).tolist()
+    # seed the shared prefix, churn the pool past eviction, re-hit it
+    engine.submit(shared + tail(3))
+    engine.run_to_completion()
+    for _ in range(6):
+        engine.submit(tail(13))
+    engine.run_to_completion()
+    engine.submit(shared + tail(3))
+    engine.run_to_completion()
+    m = engine.metrics
+    assert m.steadystate_compiles == 0, (
+        "spill catalog engine compiled past the freeze: "
+        f"{m.steadystate_compiles}"
+    )
+    assert m.blocks_spilled > 0, (
+        "churn drive never spilled a block (pool too large or LRU broken)"
+    )
+    assert m.restore_hits > 0, (
+        "prefix re-hit never restored from the host tier"
+    )
+    assert m.restore_uploads > 0 and m.h2d_uploads >= m.restore_uploads, (
+        "restore uploads not metered through the h2d funnel: "
+        f"restore={m.restore_uploads} h2d={m.h2d_uploads}"
+    )
+    return (
+        audit_programs(engine)
+        + _sched_trace_findings("catalog-spill", engine)
+        + _catalog_drift("catalog-spill", engine)
+        + _costs_drift("catalog-spill", engine)
+    )
+
+
 def entry_catalog_tp2():
     """Same contract under a pure-tp=2 mesh: the prewarmed 9-key manifest
     must bound the shard_mapped registry exactly."""
@@ -606,6 +686,7 @@ def entry_decode_tp2():
 CATALOG = (
     ("catalog-int8", entry_catalog),
     ("catalog-fused", entry_catalog_fused),
+    ("catalog-spill", entry_catalog_spill),
     ("decode", entry_decode),
     ("decode-int8", entry_decode_int8),
     ("decode-int8-mxu", entry_decode_int8_mxu),
@@ -665,6 +746,7 @@ def main(argv=None) -> int:
         entries = {
             "catalog-int8": _catalog_engine(prewarm=False).catalog,
             "catalog-fused": _catalog_fused_engine(prewarm=False).catalog,
+            "catalog-spill": _catalog_spill_engine(prewarm=False).catalog,
         }
         initialize_model_parallel(
             tensor_model_parallel_size=2, devices=jax.devices()[:2]
@@ -692,6 +774,9 @@ def main(argv=None) -> int:
             "catalog-int8": _cost_lines(_catalog_engine(prewarm=False)),
             "catalog-fused": _cost_lines(
                 _catalog_fused_engine(prewarm=False)
+            ),
+            "catalog-spill": _cost_lines(
+                _catalog_spill_engine(prewarm=False)
             ),
         }
         initialize_model_parallel(
@@ -734,6 +819,10 @@ def main(argv=None) -> int:
         )
         drift += _costs_drift(
             "catalog-fused", _catalog_fused_engine(prewarm=False),
+            args.costs_file,
+        )
+        drift += _costs_drift(
+            "catalog-spill", _catalog_spill_engine(prewarm=False),
             args.costs_file,
         )
         initialize_model_parallel(

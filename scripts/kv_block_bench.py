@@ -14,6 +14,10 @@ Gates (record still prints on failure, like infer_bench_stage.py):
 - >= ``--min-skip`` of prompt tokens admitted by prefix reference
   (default 0.5 — the ISSUE acceptance bar; the default 16x256+32 workload
   actually lands ~0.83)
+- tiered-KV churn leg (``--skip-spill`` to omit): a multi-tenant
+  workload sharing 8 system prompts over an eviction-forcing pool, spill
+  on vs off — restore hit rate > 0, byte-identical outputs, and
+  tokens/step no worse than the recompute baseline (5% floor)
 
 Usage::
 
@@ -49,6 +53,11 @@ def build_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--min-skip", type=float, default=0.5)
     ap.add_argument("--skip-dense", action="store_true",
                     help="skip the dense run (no equivalence gate)")
+    ap.add_argument("--skip-spill", action="store_true",
+                    help="skip the tiered-KV spill churn leg")
+    ap.add_argument("--churn-requests", type=int, default=None,
+                    help="requests in the spill churn leg (default 64, "
+                    "24 smoke)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="virtual CPU mesh (testing only)")
@@ -62,6 +71,98 @@ def build_args(argv=None) -> argparse.Namespace:
         args.block_size = 8
         args.num_blocks = 64
     return args
+
+
+def run_spill_leg(args: argparse.Namespace, config, params, gen) -> dict:
+    """Tiered-KV churn leg: many users sharing 8 long system prompts over
+    a pool deliberately too small to keep them all resident, run through
+    a spill-disabled (recompute) engine and a spill-enabled twin.
+    ``restore_crossover`` is forced sky-high: tiny-bench prefill FLOPs
+    are nearly free, and the leg measures the restore mechanism —
+    byte-identity, hit rate, and tokens/step vs recompute — not the
+    pricing policy (docs/serving.md "Tiered KV storage")."""
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.inference import InferenceEngine
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    n = args.churn_requests or (24 if args.smoke else 64)
+    rng = np.random.default_rng(args.seed + 1)
+    system = [
+        rng.integers(0, config.vocab_size, size=(24,)).tolist()
+        for _ in range(8)
+    ]
+    prompts = [
+        system[i % 8]
+        + rng.integers(0, config.vocab_size, size=(int(rng.integers(4, 9)),))
+        .tolist()
+        for i in range(n)
+    ]
+
+    runs = {}
+    for spill in (False, True):
+        eng = PagedServingEngine(
+            InferenceEngine(
+                config, params, max_batch=4, max_seq_len=64,
+                buckets=[16, 32],
+            ),
+            gen,
+            PagedConfig(
+                block_size=8, num_blocks=28,
+                spill_enabled=spill,
+                host_tier_bytes=(1 << 30) if spill else 0,
+                restore_crossover=1e9 if spill else 1.0,
+            ),
+        )
+        for p in prompts:
+            eng.submit(p)
+        t0 = time.perf_counter()
+        outs = eng.run_to_completion()
+        wall = time.perf_counter() - t0
+        m = eng.metrics
+        steps = eng._step_index
+        runs[spill] = {
+            "outs": outs,
+            "wall_s": round(wall, 3),
+            "tokens_per_step": (
+                sum(len(o) for o in outs.values()) / steps if steps else 0.0
+            ),
+            "metrics": m,
+        }
+    base, spl = runs[False], runs[True]
+    ms = spl["metrics"]
+    rec = {
+        "churn_requests": n,
+        "churn_base_wall_s": base["wall_s"],
+        "churn_spill_wall_s": spl["wall_s"],
+        "churn_base_tokens_per_step": round(base["tokens_per_step"], 3),
+        "churn_spill_tokens_per_step": round(spl["tokens_per_step"], 3),
+        "churn_blocks_spilled": ms.blocks_spilled,
+        "churn_blocks_restored": ms.blocks_restored,
+        "churn_restore_hits": ms.restore_hits,
+        "churn_restore_hit_rate": ms.snapshot()["restore_hit_rate"],
+        "churn_prefill_chunks_base": base["metrics"].prefill_chunks,
+        "churn_prefill_chunks_spill": ms.prefill_chunks,
+        "churn_spill_equivalent": base["outs"] == spl["outs"],
+    }
+    failures = []
+    if not rec["churn_spill_equivalent"]:
+        failures.append("spill churn outputs diverge from recompute baseline")
+    if not ms.restore_hits > 0:
+        failures.append(
+            f"spill churn never restored ({ms.blocks_spilled} spilled)"
+        )
+    if base["tokens_per_step"] and (
+        spl["tokens_per_step"] < 0.95 * base["tokens_per_step"]
+    ):
+        failures.append(
+            "spill churn tokens/step regressed >5%: "
+            f"{spl['tokens_per_step']:.3f} vs {base['tokens_per_step']:.3f}"
+        )
+    return rec, failures
 
 
 def run_bench(args: argparse.Namespace) -> dict:
@@ -147,6 +248,10 @@ def run_bench(args: argparse.Namespace) -> dict:
         failures.append(
             f"prefix skip {m.prefix_skip_fraction():.3f} < {args.min_skip}"
         )
+    if not args.skip_spill:
+        spill_rec, spill_failures = run_spill_leg(args, config, params, gen)
+        record.update(spill_rec)
+        failures.extend(spill_failures)
     if failures:
         record["gate_failure"] = "; ".join(failures)
     return record

@@ -152,6 +152,30 @@ def render_snapshot(snap: dict) -> str:
                     )
                 )
                 lines.append(f"  pad/rung {tag:<8} {row}")
+    # tiered-KV host-tier panel (docs/serving.md "Tiered KV storage"):
+    # only rendered for spill-enabled engines (nonzero budget), so
+    # pre-spill records and spill-off engines draw unchanged
+    if g("host_tier_budget_bytes"):
+        mib = 2**20
+        budget = float(g("host_tier_budget_bytes", 0) or 0)
+        resident = float(g("host_tier_bytes", 0) or 0)
+        hit = float(g("restore_hit_rate", 0.0) or 0.0)
+        lines.append(
+            f"host tier  {resident / mib:.1f}/{budget / mib:.0f} MiB "
+            f"[{_bar(resident / budget if budget else 0.0)}]  "
+            f"entries {g('host_tier_entries', 0)}  "
+            f"tier_evictions {g('host_tier_evictions', 0)}  "
+            f"spilled_nodes {g('spilled_nodes', 0)}"
+        )
+        lines.append(
+            f"  spill    out {g('blocks_spilled', 0)} blocks "
+            f"({float(g('spill_bytes', 0) or 0) / mib:.1f} MiB)  "
+            f"back {g('blocks_restored', 0)} "
+            f"({float(g('restore_bytes', 0) or 0) / mib:.1f} MiB)  "
+            f"hit_rate {hit:.2f} [{_bar(hit)}]  "
+            f"fallbacks {g('restore_fallbacks', 0)}  "
+            f"declined {g('restore_declined', 0)}"
+        )
     if "slo_alerts" in snap and (
         g("slo_burn_ttft") or g("slo_burn_tpot") or g("slo_alerts")
     ):
@@ -353,6 +377,9 @@ def _demo() -> int:
             # fused mixed-mode demo coverage: the dispatch panel row
             # shows a nonzero pmixed count
             fused_step=True, prefill_chunk_tokens=4,
+            # tiered-KV demo coverage: the host-tier panel renders (the
+            # small demo workload never evicts, so the gauges stay 0)
+            spill_enabled=True, host_tier_bytes=64 << 20,
             # graftplan demo coverage: a TablePolicy engine so the
             # policy panel renders (the demo table loads below)
             step_policy="table",

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """graftserve load harness: simulated clients against the front door.
 
-Two legs, both seeded and CPU-hosted on the tiny model:
+Three legs, all seeded and CPU-hosted on the tiny model:
 
 1. **Policy comparison** — the same mixed-class/mixed-tenant workload is
    burst- (smoke) or wave- (full) submitted into otherwise identical
@@ -18,7 +18,15 @@ Two legs, both seeded and CPU-hosted on the tiny model:
      for an SLO scheduler that reorders admission without taxing
      throughput.
 
-2. **Async streaming clients** — a :class:`~serving.server.GraftServer`
+2. **Tiered-KV churn** — a multi-tenant workload (many simulated users
+   sharing a few long system prompts) over a pool sized to force
+   eviction, run through a spill-disabled (recompute) engine and a
+   spill-enabled one; gated on byte-identical token streams, restore
+   hit rate > 0, strictly fewer prefill dispatches than the recompute
+   baseline, tokens/step no worse, and zero h2d uploads outside the
+   metered restore path (docs/serving.md "Tiered KV storage").
+
+3. **Async streaming clients** — a :class:`~serving.server.GraftServer`
    drives a third engine while concurrent asyncio clients submit, stream
    tokens, and cancel mid-stream; gated on zero open streams at the end,
    the expected cancel count, and the same invariant/automaton sweep.
@@ -119,6 +127,181 @@ def make_engine_factory():
         )
 
     return factory
+
+
+def make_churn_engine(spill: bool):
+    """Tiered-KV churn engine: same tiny model as the policy legs but a
+    deliberately small pool, so a multi-tenant workload sharing a few
+    system prompts keeps evicting the shared prefixes between re-uses.
+    ``spill=True`` arms the host tier with ``restore_crossover`` forced
+    sky-high — tiny-model prefill FLOPs are nearly free, and the leg
+    measures the restore *mechanism* (hit rate, skipped prefill work,
+    byte-identity), not the pricing policy."""
+    global _STATE
+    import jax
+
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.models.llama import (
+        LLAMA_CONFIGS,
+        LlamaForCausalLM,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    if _STATE is None:
+        cfg = LLAMA_CONFIGS["tiny"]
+        params = LlamaForCausalLM(cfg).init(jax.random.key(0))
+        _STATE = (cfg, params)
+    cfg, params = _STATE
+    return PagedServingEngine(
+        InferenceEngine(
+            cfg, params, max_batch=4, max_seq_len=64, buckets=[16, 32],
+        ),
+        GenerationConfig(max_new_tokens=6),
+        PagedConfig(
+            block_size=8, num_blocks=28, prefill_chunk_tokens=8,
+            async_loop=True,
+            spill_enabled=spill,
+            host_tier_bytes=(1 << 30) if spill else 0,
+            restore_crossover=1e9 if spill else 1.0,
+        ),
+        precompile=False,
+    )
+
+
+def make_churn_workload(seed: int, n_requests: int, n_system: int = 8):
+    """Multi-tenant churn: ``n_requests`` simulated users sharing
+    ``n_system`` long system prompts (3 blocks each — together larger
+    than the churn engine's cached headroom, so every prefix keeps
+    getting evicted between re-uses), round-robin across tenants.
+    Every request is the system prompt plus a short per-user tail."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    vocab = 128
+    system = [
+        rng.integers(0, vocab, size=(24,)).tolist() for _ in range(n_system)
+    ]
+    work = []
+    for i in range(n_requests):
+        tail = rng.integers(0, vocab, size=(int(rng.integers(4, 9)),))
+        work.append((
+            system[i % n_system] + tail.tolist(),
+            "batch", TENANTS[i % len(TENANTS)],
+        ))
+    return work
+
+
+def run_churn_leg(workload, wave: int = 0) -> int:
+    """The tiered-KV acceptance leg: the same churn workload through a
+    spill-disabled (recompute) engine and a spill-enabled one. Gates:
+
+    - both runs finish everything, audits/automaton/leaks clean;
+    - token streams **byte-identical** — restore-over-recompute is an
+      optimization, never a numerics change;
+    - the spill run restores (restore hit rate > 0) and dispatches
+      **strictly fewer** prefill programs than the recompute baseline
+      (restored prefixes skip re-prefill);
+    - tokens/step no worse than the recompute baseline (5% floor, same
+      tolerance as the policy legs);
+    - zero steady-state uploads outside the metered restore path: every
+      h2d upload past the baseline's count is accounted in
+      ``restore_uploads``.
+    """
+    rc = 0
+    runs = {}
+    for spill in (False, True):
+        eng = make_churn_engine(spill)
+        todo = list(workload)
+        if not wave:
+            for prompt, sc, tenant in todo:
+                eng.submit(prompt, service_class=sc, tenant=tenant)
+            todo = []
+        alive = True
+        while alive or todo:
+            for prompt, sc, tenant in todo[:wave]:
+                eng.submit(prompt, service_class=sc, tenant=tenant)
+            todo = todo[wave:] if wave else []
+            alive = eng.step()
+        label = "churn-spill" if spill else "churn-base"
+        rc |= _audit_clean(eng, label)
+        m = eng.metrics
+        if m.failed_requests or m.finished != len(workload):
+            print(
+                f"serving_load: GATE: {label} finished={m.finished} "
+                f"failed={m.failed_requests} of {len(workload)}"
+            )
+            rc = 1
+        steps = eng._step_index
+        runs[spill] = {
+            "outs": {r: tuple(req.out) for r, req in eng._finished.items()},
+            "tokens_per_step": (
+                sum(len(r.out) for r in eng._finished.values()) / steps
+                if steps else 0.0
+            ),
+            "prefill_chunks": m.prefill_chunks,
+            "h2d_uploads": m.h2d_uploads,
+            "restore_uploads": m.restore_uploads,
+            "restore_hits": m.restore_hits,
+            "blocks_spilled": m.blocks_spilled,
+            "blocks_restored": m.blocks_restored,
+            "restore_hit_rate": m.snapshot()["restore_hit_rate"],
+        }
+    base, spl = runs[False], runs[True]
+    if base["outs"] != spl["outs"]:
+        bad = [
+            r for r in base["outs"]
+            if base["outs"][r] != spl["outs"].get(r)
+        ]
+        print(
+            f"serving_load: GATE: churn token streams diverge under spill "
+            f"(rids {bad[:8]}{'...' if len(bad) > 8 else ''})"
+        )
+        rc = 1
+    if not spl["restore_hits"] > 0:
+        print(
+            "serving_load: GATE: churn spill leg never restored "
+            f"(spilled={spl['blocks_spilled']})"
+        )
+        rc = 1
+    if not spl["prefill_chunks"] < base["prefill_chunks"]:
+        print(
+            "serving_load: GATE: restored prefixes did not skip prefill "
+            f"dispatches: spill {spl['prefill_chunks']} vs "
+            f"baseline {base['prefill_chunks']}"
+        )
+        rc = 1
+    if base["tokens_per_step"] and (
+        spl["tokens_per_step"] < 0.95 * base["tokens_per_step"]
+    ):
+        print(
+            "serving_load: GATE: churn tokens/step regressed >5% under "
+            f"spill: {spl['tokens_per_step']:.3f} vs "
+            f"{base['tokens_per_step']:.3f}"
+        )
+        rc = 1
+    extra = spl["h2d_uploads"] - base["h2d_uploads"]
+    if extra > spl["restore_uploads"]:
+        print(
+            "serving_load: GATE: spill leg made h2d uploads outside the "
+            f"metered restore path: +{extra} vs restore_uploads="
+            f"{spl['restore_uploads']}"
+        )
+        rc = 1
+    print(
+        f"serving_load: churn leg: {len(workload)} requests, "
+        f"{spl['blocks_spilled']} spilled / {spl['blocks_restored']} "
+        f"restored (hit rate {spl['restore_hit_rate']}); prefill "
+        f"dispatches {base['prefill_chunks']} -> {spl['prefill_chunks']}; "
+        f"tokens/step {base['tokens_per_step']:.3f} -> "
+        f"{spl['tokens_per_step']:.3f}"
+    )
+    return rc
 
 
 def make_workload(seed: int, n_interactive: int, n_batch: int):
@@ -423,6 +606,10 @@ def main(argv=None) -> int:
         )
         rc |= rc_t
         rc |= check_comparison(workload, fifo_stats, tab_stats, label="table")
+    churn_n = 24 if args.smoke else max(total // 4, 2000)
+    rc |= run_churn_leg(
+        make_churn_workload(args.seed, churn_n), wave=wave
+    )
     rc |= asyncio.run(run_async_leg(factory, clients, args.seed))
     print(f"serving_load: {'FAIL' if rc else 'clean'} "
           f"({total} requests, {clients} async clients)")

@@ -229,6 +229,10 @@ EXPECTED_SNAPSHOT_KEYS = {
     "drafter_faults", "degradation_level", "degradations",
     "audit_violations", "programs_compiled", "prewarm_compiles",
     "steadystate_compiles",
+    # tiered KV storage (host-RAM spill tier)
+    "blocks_spilled", "blocks_restored", "spill_bytes", "restore_bytes",
+    "restore_hits", "restore_fallbacks", "restore_declined",
+    "restore_uploads",
     # fused on-device sampling
     "sampled_steps", "host_sample_fallbacks", "rng_reseeds",
     # graftmeter: pad-waste / dispatch-cost counters + cost-ledger gauges
@@ -245,17 +249,19 @@ EXPECTED_SNAPSHOT_KEYS = {
     "policy_table_id", "policy_table_stale", "policy_simulated_burn",
     # derived
     "prefix_skip_fraction", "accept_rate", "host_schedule_ms_per_step",
-    "device_wait_ms_per_step", "dispatches_per_step",
+    "device_wait_ms_per_step", "dispatches_per_step", "restore_hit_rate",
     # graftmeter derived
     "pad_waste_frac", "decode_pad_frac", "prefill_pad_frac",
     "achieved_flops_per_s", "mfu_est", "bandwidth_util_est",
     # latency histogram summaries
     "ttft_ms", "tpot_ms", "step_latency_ms", "accept_len", "queue_depth",
-    # allocator stats
+    # allocator stats (host-tier gauges zero-default when spill is off)
     "num_blocks", "block_size", "active_blocks", "cached_blocks",
     "free_blocks", "block_utilization", "evictions", "cow_copies",
+    "host_tier_bytes", "host_tier_budget_bytes", "host_tier_entries",
+    "host_tier_evictions",
     # radix index
-    "prefix_hit_rate", "radix_nodes",
+    "prefix_hit_rate", "radix_nodes", "spilled_nodes",
 }
 
 
