@@ -113,7 +113,9 @@ AUTOMATON: Tuple[Dict[str, str], ...] = (
     dict(action="PREFILL_CHUNK", guard="outstanding == 0", effect="-"),
     dict(action="DECODE_DISPATCH", guard="outstanding <= 1; lanes not freed",
          effect="outstanding += 1"),
-    dict(action="VERIFY", guard="outstanding == 0; lanes not freed",
+    dict(action="VERIFY",
+         guard="outstanding == 0; lanes not freed; "
+               "tree meta (nodes) within the lane draft budget",
          effect="- (same-step readback)"),
     dict(action="MIXED_DISPATCH", guard="outstanding == 0; lanes not freed",
          effect="- (same-step readback)"),
@@ -170,6 +172,12 @@ _HINTS = {
     "bookkeeping": (
         "the recorded trace is internally inconsistent — an emission "
         "site is missing or double-counted in serving/engine.py"
+    ),
+    "tree-meta": (
+        "a tree VERIFY record must carry a node count consistent with "
+        "its lane set and rung width (each lane offers at most k packed "
+        "draft nodes); an out-of-range count means the packed payload "
+        "build and the action emission disagree in serving/engine.py"
     ),
 }
 
@@ -241,6 +249,17 @@ def advance(state: ScheduleState, act: StepAction, where: str) -> List[Finding]:
                 f"verify dispatch into freed lane(s) {hit}",
                 detail=f"lanes={hit}",
             ))
+        if meta.get("tree"):
+            nodes = meta.get("nodes")
+            k = int(meta.get("k", 0) or 0)
+            cap = len(lanes) * max(k, 0)
+            if not isinstance(nodes, int) or not 0 <= nodes <= cap:
+                v.append(_finding(
+                    "tree-meta", where,
+                    f"tree VERIFY node count {nodes!r} outside "
+                    f"[0, {cap}] (lanes={len(lanes)}, k={k})",
+                    detail=f"nodes={nodes!r} cap={cap}",
+                ))
     elif t is ActionType.MIXED_DISPATCH:
         if state.outstanding:
             v.append(_finding(

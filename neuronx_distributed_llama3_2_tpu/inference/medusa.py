@@ -64,6 +64,23 @@ class MedusaBuffers:
     def tree_len(self) -> int:
         return len(self.tree_indices)
 
+    def packed_parents(self) -> np.ndarray:
+        """The tree as a packed parents vector — the form the paged
+        engine's tree-verify path (``LlamaDecode.tree_verify_step``,
+        ``serving/drafter.py`` ``propose_tree``) consumes: ``parents[i]``
+        is slot ``i``'s parent slot, ``parents[0] == 0`` (the root is its
+        own parent by convention). Slots are prefix-sorted by (depth,
+        ranks), so parents always precede children — a Medusa static tree
+        plugs straight into the packed ancestor-bitmask kernel operand
+        with draft-head top-k tokens filling the node slots."""
+        parents = np.zeros(self.tree_len, np.int32)
+        for i in range(1, self.tree_len):
+            anc = np.nonzero(
+                self.ancestor_mask[i] & (self.depths == self.depths[i] - 1)
+            )[0]
+            parents[i] = int(anc[0])
+        return parents
+
 
 def generate_medusa_buffers(
     medusa_choices: Sequence[Sequence[int]] = DEFAULT_MEDUSA_CHOICES,

@@ -255,12 +255,14 @@ class LlamaDecode:
         full cache. Caller guarantees ``position + T <= kv_limit``.
 
         ``tree``: Medusa-style tree verification — a pair
-        ``(depths (T,) int32, ancestor_mask (T, T) bool)``. The fresh block
-        is a candidate *tree*, not a sequence: token i sits at sequence
-        depth ``position + depths[i]`` (rope + causal base) but is written
-        at cache row ``position + i``; within the block, query i attends
-        key j iff ``ancestor_mask[i, j]`` (its ancestors on the tree path),
-        plus the whole committed prefix.
+        ``(depths (T,) int32, ancestor_mask (T, T) bool)``, or the batched
+        per-lane form ``(depths (b, T), ancestor_mask (b, T, T))`` (packed
+        draft trees from the serving drafter differ lane to lane). The
+        fresh block is a candidate *tree*, not a sequence: token i sits at
+        sequence depth ``position + depths[i]`` (rope + causal base) but is
+        written at cache row ``position + i``; within the block, query i
+        attends key j iff ``ancestor_mask[i, j]`` (its ancestors on the
+        tree path), plus the whole committed prefix.
 
         ``block_tables``: the paged-KV path. ``cache`` must be a
         :class:`PagedKVCache` and row ``i``'s logical position ``p`` lives at
@@ -291,7 +293,10 @@ class LlamaDecode:
         if tree is None:
             pos_block = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
         else:
-            pos_block = positions[:, None] + tree[0][None, :]
+            depths = tree[0]
+            pos_block = positions[:, None] + (
+                depths if depths.ndim == 2 else depths[None, :]
+            )
         # quantized paged pool: each layer's cache slice travels as a
         # (payload, scale) pair through the scan, so _decode_layer and the
         # per-family overrides stay signature-stable (they only hand the
@@ -534,9 +539,12 @@ class LlamaDecode:
                 # gather-free read: the kernel dereferences the block table
                 # inside its BlockSpec index maps, so the (b, limit, NKV, D)
                 # K/V copy below never materializes (flash-decoding split-K,
-                # kernels/paged_attention_pallas). Linear fresh blocks only:
-                # the kernel's block-causal mask row <= position + ti is the
-                # dense path's j <= position + t, per fresh token.
+                # kernels/paged_attention_pallas). Linear fresh blocks ride
+                # the kernel's block-causal mask row <= position + ti (the
+                # dense path's j <= position + t, per fresh token); tree
+                # blocks hand their ancestor matrix in as per-node int32
+                # bitmasks, so every candidate branch shares one KV DMA
+                # per block.
                 from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (
                     paged_flash_decode,
                     paged_flash_decode_tp,
@@ -545,6 +553,20 @@ class LlamaDecode:
                     state as parallel_state,
                 )
 
+                tree_bits = None
+                if tree is not None:
+                    anc = tree[1]
+                    if anc.ndim == 2:
+                        anc = jnp.broadcast_to(
+                            anc[None], (q.shape[0],) + anc.shape
+                        )
+                    t_nodes = anc.shape[-1]
+                    bits = jnp.zeros(anc.shape[:2], jnp.int32)
+                    for m_ in range(t_nodes):
+                        bits = bits | (
+                            anc[:, :, m_].astype(jnp.int32) << m_
+                        )
+                    tree_bits = bits
                 if (
                     parallel_state.model_parallel_is_initialized()
                     and parallel_state.get_parallel_state().mesh.size > 1
@@ -562,14 +584,14 @@ class LlamaDecode:
                         mesh=parallel_state.get_parallel_state().mesh,
                         kv_limit=limit, k_scale=ksc, v_scale=vsc,
                         quant_mxu=c.quant_mxu and ksc is not None,
-                        row_live=row_live,
+                        row_live=row_live, tree_bits=tree_bits,
                     )
                 else:
                     att = paged_flash_decode(
                         q, kc, vc, block_tables, positions, kv_limit=limit,
                         k_scale=ksc, v_scale=vsc,
                         quant_mxu=c.quant_mxu and ksc is not None,
-                        row_live=row_live,
+                        row_live=row_live, tree_bits=tree_bits,
                     )
                 att = constrain(att, P(BATCH_AXES, None, ha, None))
             else:
@@ -775,6 +797,147 @@ class LlamaDecode:
             return emitted, accept, new_tokens, new_positions, finite, cache
         return emitted, accept, new_tokens, new_positions, cache
 
+    def _tree_frontier_commit(
+        self, cache, block_tables, positions, depths, amask, best
+    ):
+        """Relocate the accepted root→leaf path to the true frontier. A
+        packed tree block writes node ``j``'s K/V at row ``positions + j``
+        (branch-interleaved), but the lane's committed history must occupy
+        consecutive rows ``positions + 1 .. positions + accept``. Gather the
+        accepted path's rows and scatter them depth-ordered at the frontier
+        through the same flat-pool indexing the fresh-block write uses — no
+        pool copy, COW/preempt/spill invariants untouched (only rows inside
+        the lane's own already-allocated blocks move). Depth slots with no
+        path node (beyond the accepted depth, or a lane that accepted
+        nothing — ``best == 0``, plain decode step included) default to an
+        identity ``src == dst`` move, so the commit is uniformly safe on
+        every lane, forced mixed lanes included. Gathers complete before the
+        single scatter, so overlapping src/dst rows read pre-commit values.
+        Quantized pools move (payload, scale) together, so relocated rows
+        dequantize exactly as they did at their packed positions."""
+        t = depths.shape[1]
+        if t <= 1:
+            return cache
+        iota = jnp.arange(t, dtype=jnp.int32)[None, :]
+        # path[i, m] — node m is on lane i's accepted root→best path
+        path = jnp.take_along_axis(amask, best[:, None, None], axis=1)[:, 0]
+        src_cols = []
+        for dd in range(1, t):
+            dsel = path & (depths == dd)  # at most one node per lane
+            node = jnp.sum(jnp.where(dsel, iota, 0), axis=1)
+            src_cols.append(jnp.where(jnp.any(dsel, axis=1), node, dd))
+        src_rows = positions[:, None] + jnp.stack(src_cols, axis=1)
+        dst_rows = (
+            positions[:, None] + 1 + jnp.arange(t - 1, dtype=jnp.int32)[None, :]
+        )
+        bs = cache.k.shape[2]
+
+        def phys(rows):
+            return (
+                jnp.take_along_axis(block_tables, rows // bs, axis=1) * bs
+                + rows % bs
+            )
+
+        src_phys, dst_phys = phys(src_rows), phys(dst_rows)
+
+        def move(arr):
+            l, nb = arr.shape[0], arr.shape[1]
+            flat = arr.reshape((l, nb * bs) + arr.shape[3:])
+            vals = flat[:, src_phys]  # (L, b, t-1, ...)
+            return flat.at[:, dst_phys].set(vals).reshape(arr.shape)
+
+        kwargs = dict(k=move(cache.k), v=move(cache.v))
+        if getattr(cache, "k_scale", None) is not None:
+            kwargs.update(
+                k_scale=move(cache.k_scale), v_scale=move(cache.v_scale)
+            )
+        return type(cache)(**kwargs)
+
+    def tree_verify_step(
+        self,
+        params: Params,
+        cache: PagedKVCache,
+        tokens: jax.Array,        # (b, t) int32 — [cur, node_1 .. node_{t-1}]
+        positions: jax.Array,     # (b,) int32 — cur's write row per lane
+        block_tables: jax.Array,  # (b, W) int32
+        parents: jax.Array,       # (b, t) int32 — parents[j] < j, node space
+        node_len: jax.Array,      # (b,) int32 — live nodes incl. root, <= t
+        *,
+        kv_limit: Optional[int] = None,
+        pos_cap: Optional[int] = None,
+        logit_poison: Optional[jax.Array] = None,
+        sampling: Optional[tuple] = None,
+    ) -> Tuple[jax.Array, ...]:
+        """One speculative **tree** verify step: the branching sibling of
+        :meth:`verify_step`. The packed candidate tree ``tokens`` (node 0 is
+        the resident token, parents precede children) is scored in ONE
+        ancestor-masked forward — node ``j`` writes K/V at row
+        ``positions + j``, attends at RoPE position ``positions + depth(j)``
+        and sees exactly the committed prefix plus its own root→self chain —
+        then the deepest root-anchored accepted path is selected on device
+        (:func:`..speculative.tree_accept_rule`) and its K/V rows are
+        relocated to the true frontier (:meth:`_tree_frontier_commit`).
+
+        Per-row targets are keyed by each node's *child landing index*
+        (``positions + 1 + depth``), so on a single-chain tree
+        (``parents[j] == j - 1``) the whole step — mask, targets, accept,
+        identity commit — reduces bit-for-bit to :meth:`verify_step`.
+        ``node_len`` caps acceptance per lane (the root is always live, so
+        ``node_len <= 1`` degrades to a plain decode step); padding nodes
+        past it are parent-clipped and self-visible only, never ancestors
+        of live nodes.
+
+        Returns the :meth:`verify_step` tuple ``(emitted (b, t),
+        accept (b,), new_tokens (b,), new_positions (b,), [finite (b,)],
+        cache)`` — ``emitted[i, :accept[i] + 1]`` is the accepted path's
+        token stream (bonus/correction last), ``new_positions = positions
+        + accept + 1`` clamped to ``pos_cap``. ``sampling`` /
+        ``logit_poison`` compose exactly as in :meth:`verify_step`."""
+        from neuronx_distributed_llama3_2_tpu.inference.speculative import (
+            tree_accept_rule,
+            tree_topology,
+        )
+
+        depths, amask = tree_topology(parents)
+        logits, cache = self.forward(
+            params, cache, tokens, positions, None,
+            block_tables=block_tables, kv_limit=kv_limit,
+            tree=(depths, amask),
+        )
+        finite = None
+        if logit_poison is not None:
+            logits, finite = self.finite_logit_check(logits, logit_poison)
+        if sampling is not None:
+            from neuronx_distributed_llama3_2_tpu.inference.sampling import (
+                sample_lanes,
+            )
+
+            rng_data, temperature, top_k, top_p = sampling
+            # targets[i, j] = the token this lane WOULD emit at node j's
+            # child landing index positions[i] + 1 + depth(j) — the same
+            # position-keyed draw the sequential fused-sampling decode of
+            # the accepted path makes, so sampled acceptance replays it
+            index = positions[:, None] + 1 + depths
+            targets = sample_lanes(
+                logits, rng_data, index, temperature, top_k, top_p
+            )
+        else:
+            targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        accept, emitted, best = tree_accept_rule(
+            tokens, targets, parents, node_len=node_len,
+            topology=(depths, amask),
+        )
+        cache = self._tree_frontier_commit(
+            cache, block_tables, positions, depths, amask, best
+        )
+        new_tokens = jnp.take_along_axis(emitted, accept[:, None], axis=1)[:, 0]
+        new_positions = positions + accept + 1
+        if pos_cap is not None:
+            new_positions = jnp.minimum(new_positions, pos_cap)
+        if finite is not None:
+            return emitted, accept, new_tokens, new_positions, finite, cache
+        return emitted, accept, new_tokens, new_positions, cache
+
     def mixed_step(
         self,
         params: Params,
@@ -791,6 +954,7 @@ class LlamaDecode:
         pos_cap: Optional[int] = None,
         logit_poison: Optional[jax.Array] = None,
         sampling: Optional[tuple] = None,
+        parents: Optional[jax.Array] = None,  # (b, t) int32 — tree topology
     ) -> Tuple[jax.Array, ...]:
         """One fused mixed-mode step: decode lanes, speculative-verify rows
         and active prefill-chunk suffixes share a single t-row block-causal
@@ -828,9 +992,21 @@ class LlamaDecode:
         to ``pos_cap``), where ``eff_pos`` is ``row_start`` on forced
         lanes and ``positions`` otherwise. ``sampling`` / ``logit_poison``
         compose exactly as in :meth:`verify_step`.
+
+        ``parents`` opts the verify rows into **tree** speculation
+        (:meth:`tree_verify_step` semantics): ``rows[:, :t-1]`` become the
+        packed draft nodes 1..t-1 of a per-lane candidate tree rooted at
+        the resident token, accepted along the deepest root-anchored path
+        and committed to the frontier. Forced lanes are steered onto the
+        single-chain topology (depth j == row j), which makes their
+        ancestor mask exactly the linear block-causal mask and their
+        frontier commit the identity — chunk semantics are unchanged.
+        ``parents=None`` (static) keeps the linear trace bitwise unchanged.
         """
         from neuronx_distributed_llama3_2_tpu.inference.speculative import (
             accept_rule,
+            tree_accept_rule,
+            tree_topology,
         )
 
         t = rows.shape[1]
@@ -844,9 +1020,19 @@ class LlamaDecode:
             jnp.concatenate([tokens[:, None], rows[:, : t - 1]], axis=1),
         )
         live = jnp.where(is_forced, row_len, row_len + 1)
+        topo = None
+        eff_parents = None
+        if parents is not None:
+            # forced lanes ride the chain topology: depths == arange(t) and
+            # a lower-triangular ancestor mask, i.e. exactly the linear
+            # block-causal mask + write rows the unfused psfx chunk uses
+            chain = jnp.maximum(jnp.arange(t, dtype=jnp.int32) - 1, 0)
+            eff_parents = jnp.where(is_forced[:, None], chain[None, :], parents)
+            topo = tree_topology(eff_parents)
         logits, cache = self.forward(
             params, cache, block, eff_pos, None,
             block_tables=block_tables, kv_limit=kv_limit, row_live=live,
+            tree=topo,
         )
         finite = None
         if logit_poison is not None:
@@ -857,18 +1043,37 @@ class LlamaDecode:
             )
 
             rng_data, temperature, top_k, top_p = sampling
-            index = eff_pos[:, None] + 1 + jnp.arange(t, dtype=jnp.int32)
+            index = eff_pos[:, None] + 1 + (
+                jnp.arange(t, dtype=jnp.int32)[None, :]
+                if topo is None
+                else topo[0]
+            )
             targets = sample_lanes(
                 logits, rng_data, index, temperature, top_k, top_p
             )
         else:
             targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        # forced lanes carry draft_len 0, so accept_rule hands back
-        # emitted == targets untouched; their accept is then overridden to
-        # land on the chunk's last row (targets[row_len - 1] is the token
-        # keyed row_start + row_len — the psfx sample index)
-        dl = jnp.where(is_forced, 0, row_len)
-        raw_accept, emitted = accept_rule(block[:, 1:], targets, draft_len=dl)
+        # forced lanes carry draft_len 0 (linear) / node_len 1 (tree), so
+        # the accept rule hands back targets / the root bonus untouched;
+        # their accept is then overridden to land on the chunk's last row
+        # (targets[row_len - 1] is the token keyed row_start + row_len —
+        # the psfx sample index) and, on the tree path, their emitted row
+        # is restored to raw targets so the override indexes the same
+        # values the linear trace would
+        if topo is None:
+            dl = jnp.where(is_forced, 0, row_len)
+            raw_accept, emitted = accept_rule(
+                block[:, 1:], targets, draft_len=dl
+            )
+        else:
+            node_len = jnp.where(is_forced, 1, row_len + 1)
+            raw_accept, emitted, best = tree_accept_rule(
+                block, targets, eff_parents, node_len=node_len, topology=topo
+            )
+            emitted = jnp.where(is_forced[:, None], targets, emitted)
+            cache = self._tree_frontier_commit(
+                cache, block_tables, eff_pos, topo[0], topo[1], best
+            )
         accept = jnp.where(
             is_forced, jnp.maximum(row_len - 1, 0), raw_accept
         )
@@ -900,12 +1105,11 @@ class LlamaDecode:
 
     def _paged_kernel_eligible(self, t: int, tree) -> bool:
         """Gate for the Pallas paged-decode kernel: the ``use_paged_kernel``
-        config opt-in, a *linear* fresh block of at most
-        ``paged_kernel_max_t`` tokens — T == 1 token-gen, speculative verify
-        blocks, and suffix-prefill chunks that fit the bound all qualify;
-        longer prefill buckets and tree verification keep the dense gather
-        (a tree's in-block mask is its ancestor matrix, not the kernel's
-        block-causal ``row <= position + ti``).
+        config opt-in and a fresh block of at most ``paged_kernel_max_t``
+        tokens — T == 1 token-gen, speculative verify blocks (linear OR
+        packed trees: the ancestor matrix rides into the kernel as per-node
+        int32 bitmasks), and suffix-prefill chunks that fit the bound all
+        qualify; longer prefill buckets keep the dense gather.
 
         Multi-device meshes are eligible when the mesh is **pure tensor
         parallel** and tp divides both head counts: the kernel then runs
@@ -920,10 +1124,12 @@ class LlamaDecode:
             state as parallel_state,
         )
 
-        if not self.config.use_paged_kernel or tree is not None:
+        if not self.config.use_paged_kernel:
             return False
         if not 1 <= t <= self.config.paged_kernel_max_t:
             return False
+        if tree is not None and t > 32:
+            return False  # ancestor sets pack into int32 bitmasks
         if (
             parallel_state.model_parallel_is_initialized()
             and parallel_state.get_parallel_state().mesh.size > 1
@@ -981,11 +1187,11 @@ class LlamaDecode:
             u = j - positions[:, None, None]  # (b,1,S_max) offset into block
             prefix_ok = j < positions[:, None, None]
             in_block = (u >= 0) & (u < t)
-            anc = tree[1][None, :, :]  # (1,T,T) [query, key-offset]
+            anc = tree[1]  # (T,T) static tree or (b,T,T) per-lane
+            if anc.ndim == 2:
+                anc = jnp.broadcast_to(anc[None, :, :], (q.shape[0], t, t))
             u_cl = jnp.clip(u, 0, t - 1)
-            tree_ok = jnp.take_along_axis(
-                jnp.broadcast_to(anc, (q.shape[0], t, t)), u_cl, axis=2
-            )
+            tree_ok = jnp.take_along_axis(anc, u_cl, axis=2)
             mask = prefix_ok | (in_block & tree_ok)
         scores = jnp.where(mask[:, None, :, :], scores, jnp.float32(-1e30))
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)

@@ -62,6 +62,104 @@ def accept_rule(drafts, greedy, draft_len=None):
     return accept, emitted
 
 
+def tree_topology(parents):
+    """Derive ``(depths (..., t), ancestors (..., t, t) bool)`` from packed
+    parent pointers.
+
+    ``parents (..., t)``: node ``j >= 1``'s parent index (``< j`` — packed
+    trees are topologically ordered, parents precede children; out-of-range
+    values are clipped). Node 0 is the root (``parents[..., 0]`` ignored).
+    ``depths[..., j]`` is node j's distance from the root and
+    ``ancestors[..., j, m]`` is True iff node m is an ancestor-or-self of
+    node j — exactly the ``(depths, ancestor_mask)`` pair
+    ``LlamaDecode.forward(tree=)`` consumes, derived per batch row so each
+    lane can carry its own candidate tree. Static Python loop over the
+    (small, static) node count: t <= k+1 <= paged_kernel_max_t."""
+    parents = jnp.asarray(parents, jnp.int32)
+    t = parents.shape[-1]
+    lead = parents.shape[:-1]
+    iota = jnp.arange(t, dtype=jnp.int32)
+    depth_cols = [jnp.zeros(lead, jnp.int32)]
+    anc_rows = [jnp.broadcast_to(iota == 0, lead + (t,))]
+    for j in range(1, t):
+        pj = jnp.clip(parents[..., j], 0, j - 1)
+        d_stack = jnp.stack(depth_cols, axis=-1)            # (..., j)
+        dj = jnp.take_along_axis(d_stack, pj[..., None], axis=-1)[..., 0] + 1
+        a_stack = jnp.stack(anc_rows, axis=-2)              # (..., j, t)
+        aj = jnp.take_along_axis(
+            a_stack, pj[..., None, None], axis=-2
+        )[..., 0, :]
+        depth_cols.append(dj)
+        anc_rows.append(aj | (iota == j))
+    return jnp.stack(depth_cols, axis=-1), jnp.stack(anc_rows, axis=-2)
+
+
+def tree_accept_rule(tokens, targets, parents, node_len=None, topology=None):
+    """Tree-aware accept: the packed-tree generalization of
+    :func:`accept_rule`, shared by host-side oracles (numpy) and the paged
+    engine's on-device tree verify (``LlamaDecode.tree_verify_step``).
+
+    ``tokens (..., t)``: the scored node tokens, node 0 = the resident
+    (root) token; ``targets (..., t)``: the target's choice for the row
+    *after* each node (argmax, or the position-keyed draw under fused
+    sampling); ``parents (..., t)``: packed parent pointers (see
+    :func:`tree_topology`); ``node_len (...,)`` optionally marks nodes
+    ``>= node_len`` as packing padding (the root is always live).
+
+    A draft node is *accepted* iff its token equals the target's
+    continuation of its parent AND its parent is accepted (the root is
+    accepted by construction) — on a single-chain tree this is exactly the
+    longest-agreeing-prefix rule of :func:`accept_rule`. Returns
+    ``(accept (...,), emitted (..., t), best (...,))``: ``accept`` is the
+    depth of the deepest accepted node, ``best`` its node index (ties —
+    equal-depth accepted leaves — break to the LOWEST node index, the
+    drafter's primary branch first), and ``emitted[..., :accept+1]`` the
+    committed tokens: the root->best path's draft tokens followed by the
+    target's correction/bonus token ``targets[..., best]``. Entries past
+    ``accept`` are meaningless."""
+    tokens = jnp.asarray(tokens, jnp.int32)
+    targets = jnp.asarray(targets, jnp.int32)
+    parents = jnp.asarray(parents, jnp.int32)
+    t = tokens.shape[-1]
+    depths, ancestors = (
+        topology if topology is not None else tree_topology(parents)
+    )
+    lead = tokens.shape[:-1]
+    iota = jnp.arange(t, dtype=jnp.int32)
+    acc_cols = [jnp.ones(lead, bool)]
+    for j in range(1, t):
+        pj = jnp.clip(parents[..., j], 0, j - 1)
+        a_stack = jnp.stack(acc_cols, axis=-1)              # (..., j)
+        parent_ok = jnp.take_along_axis(
+            a_stack, pj[..., None], axis=-1
+        )[..., 0]
+        tgt = jnp.take_along_axis(targets, pj[..., None], axis=-1)[..., 0]
+        acc_cols.append(parent_ok & (tokens[..., j] == tgt))
+    accd = jnp.stack(acc_cols, axis=-1)                     # (..., t) bool
+    if node_len is not None:
+        live = iota < jnp.asarray(node_len, jnp.int32)[..., None]
+        # the root is live whatever node_len says — an abstaining lane
+        # (node_len <= 1) is exactly a plain decode step
+        accd = accd & (live | (iota == 0))
+    # deepest accepted node; argmax's first-max tie-break = lowest index
+    eff = jnp.where(accd, depths, -1)
+    accept = jnp.max(eff, axis=-1)
+    best = jnp.argmax(eff, axis=-1).astype(jnp.int32)
+    # root->best path tokens by depth: the unique ancestor-or-self of
+    # `best` at depth d+1 fills emitted slot d (one-hot select over nodes)
+    path = jnp.take_along_axis(
+        ancestors, best[..., None, None], axis=-2
+    )[..., 0, :]                                            # (..., t) bool
+    cols = []
+    for slot in range(t):
+        dsel = path & (depths == slot + 1)
+        cols.append(jnp.sum(jnp.where(dsel, tokens, 0), axis=-1))
+    emitted = jnp.stack(cols, axis=-1).astype(jnp.int32)
+    bonus = jnp.take_along_axis(targets, best[..., None], axis=-1)
+    emitted = jnp.where(iota == accept[..., None], bonus, emitted)
+    return accept, emitted, best
+
+
 @dataclasses.dataclass
 class SpeculativeResult:
     tokens: List[int]

@@ -33,6 +33,12 @@ Structure (flash-decoding, Dao et al. 2023 — split-K for a single query row):
   (``row <= positions[lane] + ti``) — so each KV block is still DMA'd
   exactly once per (lane, head, split) and serves all t queries, instead
   of growing the grid a dimension and re-fetching the pool t times.
+- packed draft trees (tree speculation) generalize that mask: an optional
+  per-lane ``(t,)`` int32 ancestor-bitmask operand (``tree_bits``) makes
+  each query node attend the committed prefix plus exactly its ancestor
+  nodes within the block, so multiple candidate *branches* verify in one
+  forward while still sharing one KV DMA per block. A linear chain's
+  bitmasks reproduce the block-causal mask bit for bit.
 
 Interpret mode (`jax.default_backend() != "tpu"`) runs the same kernel body
 through the Pallas interpreter so the tier-1 CPU suite exercises this exact
@@ -68,7 +74,9 @@ def _decode_kernel(
     tbl_ref,   # scalar prefetch: (b, W) int32 block table (SMEM)
     pos_ref,   # scalar prefetch: (b,) int32 first-fresh-query positions (SMEM)
     *refs,     # [live_ref (b,) int32 per-lane live-row counts (SMEM, only
-    #            when has_live),] then
+    #            when has_live),]
+    #            [tree_ref (b, t) int32 per-node ancestor bitmasks (SMEM,
+    #            only when has_tree),] then
     #            q_ref (t*G, D) — this lane/kv-head's t fresh query groups,
     #            k_ref / v_ref (bs, D) — one pool block via the table,
     #            [ks_ref, vs_ref (bs, 1) — quantized scale tiles,] then
@@ -77,6 +85,7 @@ def _decode_kernel(
     #            and the m/l/acc VMEM scratch
     bs: int, bps: int, nblk: int, t: int, g: int, sm_scale: float,
     quantized: bool = False, quant_mxu: bool = False, has_live: bool = False,
+    has_tree: bool = False,
 ):
     if has_live:
         # mixed-width tile (fused_step): lane i's rows >= live_ref[i] are
@@ -86,6 +95,13 @@ def _decode_kernel(
         refs = refs[1:]
     else:
         live_ref = None
+    if has_tree:
+        # packed draft tree (tree speculation): bit m of tree_ref[i, q] is
+        # set iff node m is an ancestor-or-self of node q in lane i's tree
+        tree_ref = refs[0]
+        refs = refs[1:]
+    else:
+        tree_ref = None
     q_ref, k_ref, v_ref = refs[:3]
     refs = refs[3:]
     if quantized:
@@ -173,7 +189,24 @@ def _decode_kernel(
         # block-causal across the fresh tokens: tile row r holds query
         # token ti = r // g, which sits at sequence row pos + ti
         ti = lax.broadcasted_iota(jnp.int32, sc.shape, 0) // g
-        mask = rows <= pos + ti
+        if tree_ref is None:
+            mask = rows <= pos + ti
+        else:
+            # packed-tree mask: the committed prefix stays fully visible,
+            # and within the fresh block (node m's K/V sits at row
+            # pos + m) query node ti sees exactly its ancestor set — the
+            # per-node bitmask broadcast into the tile via a static loop
+            # over the (small) node count. A chain tree
+            # (bits[q] = (1 << (q+1)) - 1) reproduces rows <= pos + ti
+            # bit for bit.
+            bits = jnp.zeros(sc.shape, jnp.int32)
+            for q_t in range(t):
+                bits = jnp.where(ti == q_t, tree_ref[i, q_t], bits)
+            u = rows - pos
+            vis = (u >= 0) & (u < t) & (
+                (lax.shift_right_logical(bits, jnp.clip(u, 0, 31)) & 1) > 0
+            )
+            mask = (rows < pos) | vis
         sc = jnp.where(mask, sc, NEG_INF)
 
         m_prev = m_scr[:, 0]
@@ -223,6 +256,7 @@ def paged_flash_decode(
     v_scale: jax.Array | None = None,
     quant_mxu: bool = False,
     row_live: jax.Array | None = None,  # (b,) int32 live query rows per lane
+    tree_bits: jax.Array | None = None,  # (b, t) int32 ancestor bitmasks
 ) -> jax.Array:
     """Gather-free paged decode attention; returns q's shape in q.dtype.
 
@@ -251,6 +285,21 @@ def paged_flash_decode(
     ``positions[i] + t - 1``. It rides in as a third scalar-prefetch
     operand; ``None`` (the default) lowers exactly the pre-existing
     two-operand kernel, so unfused traces stay bitwise unchanged.
+
+    ``tree_bits`` marks the fresh block as a packed draft *tree* (tree
+    speculation, docs/serving.md "Tree speculation"): bit ``m`` of
+    ``tree_bits[i, q]`` is set iff node ``m`` is an ancestor-or-self of
+    node ``q`` in lane ``i``'s tree (node j's K/V sits at row
+    ``positions[i] + j``, so the in-block mask becomes the ancestor set
+    instead of ``row <= positions[i] + ti`` while the committed prefix
+    ``row < positions[i]`` stays fully visible). Requires ``t <= 32``
+    (one int32 bitmask per node; the serving path caps t at
+    ``paged_kernel_max_t``). It rides in as one more tiny (b, t)
+    scalar-prefetch operand — the per-block KV DMA is unchanged, so all
+    candidate branches share one pool read per block. A chain tree
+    (``tree_bits[i, q] = (1 << (q+1)) - 1``) is bitwise the block-causal
+    mask; ``None`` (the default) leaves every existing lowering
+    unchanged.
 
     ``quant_mxu`` (quantized pool only) keeps the q·k dot itself in low
     precision: int8 pools contract int8 × int8 operands accumulating in
@@ -311,10 +360,20 @@ def paged_flash_decode(
             "quant_mxu needs a quantized pool (k_scale/v_scale) — the fp "
             "pool has no low-bit payload to keep on the MXU"
         )
+    if tree_bits is not None:
+        if t > 32:
+            raise ValueError(
+                f"tree_bits packs ancestor sets into int32 bitmasks — "
+                f"t ({t}) must be <= 32"
+            )
+        if tree_bits.shape != (b, t):
+            raise ValueError(
+                f"tree_bits must be (b, t) = {(b, t)}, got {tree_bits.shape}"
+            )
     kernel = functools.partial(
         _decode_kernel, bs=bs, bps=bps, nblk=nblk, t=t, g=g,
         sm_scale=sm_scale, quantized=quantized, quant_mxu=quant_mxu,
-        has_live=row_live is not None,
+        has_live=row_live is not None, has_tree=tree_bits is not None,
     )
     in_specs = [
         pl.BlockSpec((None, None, tg, d), q_idx),
@@ -339,6 +398,8 @@ def paged_flash_decode(
     prefetch = [block_tables.astype(jnp.int32), positions.astype(jnp.int32)]
     if row_live is not None:
         prefetch.append(row_live.astype(jnp.int32))
+    if tree_bits is not None:
+        prefetch.append(tree_bits.astype(jnp.int32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(prefetch),
         grid=grid,
@@ -403,6 +464,7 @@ def paged_flash_decode_tp(
     v_scale: jax.Array | None = None,
     quant_mxu: bool = False,
     row_live: jax.Array | None = None,  # (b,) int32 — REPLICATED per rank
+    tree_bits: jax.Array | None = None,  # (b, t) int32 — REPLICATED per rank
 ) -> jax.Array:
     """:func:`paged_flash_decode` sharded over the tensor-parallel mesh.
 
@@ -422,9 +484,12 @@ def paged_flash_decode_tp(
       identically on every chip — per-chip pool bytes drop by tp, which is
       the multi-chip capacity win (tp× aggregate lanes/kv_limit at fixed
       per-chip HBM).
-    - block tables and positions ride in replicated, matching the serving
-      engine's device-resident state: the ``lane_set``/``table_delta``
-      scatters and the zero-upload steady state are layout-independent.
+    - block tables, positions and the optional per-lane scalars
+      (``row_live``, ``tree_bits``) ride in replicated, matching the
+      serving engine's device-resident state: the ``lane_set``/
+      ``table_delta`` scatters and the zero-upload steady state are
+      layout-independent, and a tree's ancestor bitmasks are lane data,
+      not head data — every rank masks identically.
     - the region contains NO collective: each rank's output is its head
       slice (out spec = q spec), and the model's row-parallel o-projection
       immediately after attention performs the tp reduction it already
@@ -433,6 +498,11 @@ def paged_flash_decode_tp(
     Axes the specs don't mention (dp/pp/cp/ep) replicate; eligibility
     (``_paged_kernel_eligible``) only routes here on a pure-tp mesh where
     those axes are size 1.
+
+    The operand list is assembled dynamically (one closure serves the
+    fp/quantized × row_live × tree_bits lattice) — each optional operand
+    appends itself and its spec, so adding a kernel operand never forks
+    another hand-written shard_map variant.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -446,86 +516,56 @@ def paged_flash_decode_tp(
             f"q heads ({n}) and kv heads ({nkv}) must both divide tp ({tp}); "
             "the caller (_paged_kernel_eligible) should have fallen back"
         )
+    if k_scale is None and quant_mxu:
+        raise ValueError(
+            "quant_mxu needs a quantized pool (k_scale/v_scale)"
+        )
     q_spec = (
         P(None, TP_AXIS, None) if q.ndim == 3 else P(None, None, TP_AXIS, None)
     )
     pool_spec = P(None, None, TP_AXIS, None)
+    # quantized pool: the (num_blocks, bs, NKV) scale arrays split the SAME
+    # kv-head axis as the payload pools, so each rank dequantizes its own
+    # head slice locally — zero in-region collectives
+    scale_spec = P(None, None, TP_AXIS)
+
+    operands = [q, k_pool, v_pool]
+    specs = [q_spec, pool_spec, pool_spec]
+    has_scale = k_scale is not None
+    if has_scale:
+        operands += [k_scale, v_scale]
+        specs += [scale_spec, scale_spec]
+    operands += [block_tables, positions]
+    specs += [P(None, None), P(None)]
+    has_live = row_live is not None
+    if has_live:
+        operands.append(row_live)
+        specs.append(P(None))
+    has_tree = tree_bits is not None
+    if has_tree:
+        operands.append(tree_bits)
+        specs.append(P(None, None))
+
+    def local(*args):
+        it = iter(args)
+        qs, ks, vs = next(it), next(it), next(it)
+        kss = next(it) if has_scale else None
+        vss = next(it) if has_scale else None
+        tbl, pos = next(it), next(it)
+        live = next(it) if has_live else None
+        bits = next(it) if has_tree else None
+        return paged_flash_decode(
+            qs, ks, vs, tbl, pos,
+            kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
+            k_scale=kss, v_scale=vss, quant_mxu=quant_mxu,
+            row_live=live, tree_bits=bits,
+        )
 
     # check_vma off: pallas_call carries no replication rule on either jax
     # generation; the per-rank outputs are genuinely tp-varying anyway
-    if k_scale is None:
-        if quant_mxu:
-            raise ValueError(
-                "quant_mxu needs a quantized pool (k_scale/v_scale)"
-            )
-        if row_live is None:
-            def local(qs, ks, vs, tbl, pos):
-                return paged_flash_decode(
-                    qs, ks, vs, tbl, pos,
-                    kv_limit=kv_limit, num_splits=num_splits,
-                    interpret=interpret,
-                )
-
-            return compat.shard_map(
-                local, mesh,
-                in_specs=(q_spec, pool_spec, pool_spec, P(None, None), P(None)),
-                out_specs=q_spec,
-                check_vma=False,
-            )(q, k_pool, v_pool, block_tables, positions)
-
-        # mixed-width tile: the per-lane live counts replicate exactly
-        # like positions — still no in-region collective
-        def local_l(qs, ks, vs, tbl, pos, live):
-            return paged_flash_decode(
-                qs, ks, vs, tbl, pos, row_live=live,
-                kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
-            )
-
-        return compat.shard_map(
-            local_l, mesh,
-            in_specs=(
-                q_spec, pool_spec, pool_spec, P(None, None), P(None), P(None),
-            ),
-            out_specs=q_spec,
-            check_vma=False,
-        )(q, k_pool, v_pool, block_tables, positions, row_live)
-
-    # quantized pool: the (num_blocks, bs, NKV) scale arrays split the SAME
-    # kv-head axis as the payload pools, so each rank dequantizes its own
-    # head slice locally — still zero in-region collectives
-    scale_spec = P(None, None, TP_AXIS)
-
-    if row_live is None:
-        def local_q(qs, ks, vs, kss, vss, tbl, pos):
-            return paged_flash_decode(
-                qs, ks, vs, tbl, pos, k_scale=kss, v_scale=vss,
-                kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
-                quant_mxu=quant_mxu,
-            )
-
-        return compat.shard_map(
-            local_q, mesh,
-            in_specs=(
-                q_spec, pool_spec, pool_spec, scale_spec, scale_spec,
-                P(None, None), P(None),
-            ),
-            out_specs=q_spec,
-            check_vma=False,
-        )(q, k_pool, v_pool, k_scale, v_scale, block_tables, positions)
-
-    def local_ql(qs, ks, vs, kss, vss, tbl, pos, live):
-        return paged_flash_decode(
-            qs, ks, vs, tbl, pos, k_scale=kss, v_scale=vss, row_live=live,
-            kv_limit=kv_limit, num_splits=num_splits, interpret=interpret,
-            quant_mxu=quant_mxu,
-        )
-
     return compat.shard_map(
-        local_ql, mesh,
-        in_specs=(
-            q_spec, pool_spec, pool_spec, scale_spec, scale_spec,
-            P(None, None), P(None), P(None),
-        ),
+        local, mesh,
+        in_specs=tuple(specs),
         out_specs=q_spec,
         check_vma=False,
-    )(q, k_pool, v_pool, k_scale, v_scale, block_tables, positions, row_live)
+    )(*operands)

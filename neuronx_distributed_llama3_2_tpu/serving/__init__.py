@@ -31,6 +31,7 @@ from neuronx_distributed_llama3_2_tpu.serving.catalog import (
 from neuronx_distributed_llama3_2_tpu.serving.drafter import (
     DraftProposer,
     NGramDrafter,
+    TreeDrafter,
 )
 from neuronx_distributed_llama3_2_tpu.serving.engine import (
     SERVICE_CLASSES,
@@ -109,6 +110,7 @@ __all__ = [
     "InjectedFault",
     "InvariantViolation",
     "NGramDrafter",
+    "TreeDrafter",
     "PagedConfig",
     "PagedServingEngine",
     "RadixPrefixIndex",

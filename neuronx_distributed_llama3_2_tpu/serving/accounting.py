@@ -41,7 +41,9 @@ from neuronx_distributed_llama3_2_tpu.serving.catalog import format_key
 # program kinds that run model math — these must carry nonzero FLOPs
 # after harvest (the graftcheck GC009 completeness contract); the
 # remaining kinds only move bytes and report their element traffic
-COMPUTE_KINDS = frozenset({"pctx", "psfx", "pdecode", "pverify", "pmixed"})
+COMPUTE_KINDS = frozenset(
+    {"pctx", "psfx", "pdecode", "pverify", "ptree", "pmixed"}
+)
 MOVE_KINDS = frozenset(
     {"copy_block", "lane_set", "table_delta", "block_save", "block_restore"}
 )
@@ -228,7 +230,12 @@ def analytic_cost(key: tuple, dims: EngineDims) -> Tuple[float, float, str]:
         f = dims.max_batch * _flops_per_token(dims, kv, dims.quant_mxu)
         rows = dims.max_batch * kv
         tokens = dims.max_batch
-    elif kind == "pverify":
+    elif kind in ("pverify", "ptree"):
+        # ptree (packed-tree verify) prices identically to linear verify:
+        # the forward is the same B·(k+1) query rows over kv+k attention
+        # extent — the ancestor mask only changes which rows each query
+        # may see, not how many it streams, and a padded shallow tree
+        # wastes exactly the rung's pad rows either way
         kv, k = int(key[1]), int(key[2])
         f = dims.max_batch * (k + 1) * _flops_per_token(
             dims, kv + k, dims.quant_mxu

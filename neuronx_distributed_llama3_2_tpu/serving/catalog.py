@@ -162,6 +162,10 @@ class CatalogManifest:
     # block_save/block_restore move programs to the universe (and only
     # then — registering them on a spill-free engine is a GC007 finding)
     spill: bool = False
+    # PagedConfig.spec_tree: verify rungs become ptree keys (packed-tree
+    # ancestor-masked verify) instead of pverify — same kv × k product,
+    # so the manifest stays exactly as bounded as linear speculation's
+    spec_tree: bool = False
 
     @classmethod
     def from_engine(cls, engine: Any) -> "CatalogManifest":
@@ -193,6 +197,7 @@ class CatalogManifest:
             gather_variants=bool(engine.paged.degrade_after_faults),
             fused_step=bool(getattr(engine, "_fused_step", False)),
             spill=bool(getattr(engine, "_spill", False)),
+            spec_tree=bool(getattr(engine, "_spec_tree", False)),
         )
 
     def _expand(self, gathers: Tuple[bool, ...]) -> List[tuple]:
@@ -216,9 +221,10 @@ class CatalogManifest:
                     keys.append(("psfx", b, kv, cfg, g))
             for kv in lad.kv_buckets:
                 keys.append(("pdecode", cfg, kv, g, chk))
+            verify_kind = "ptree" if self.spec_tree else "pverify"
             for k in lad.verify_t:
                 for kv in lad.kv_buckets:
-                    keys.append(("pverify", kv, k, g, chk))
+                    keys.append((verify_kind, kv, k, g, chk))
             for t in lad.mixed_t:
                 for kv in lad.kv_buckets:
                     keys.append(("pmixed", t, kv, cfg, g, chk))
@@ -251,6 +257,8 @@ class CatalogManifest:
             flags.append("fused-step")
         if self.spill:
             flags.append("spill")
+        if self.spec_tree:
+            flags.append("spec-tree")
         return (
             f"B={lad.decode_batch} prefill={list(lad.prefill_buckets)} "
             f"kv={list(lad.kv_buckets)} verify_t={list(lad.verify_t)} "
@@ -325,7 +333,7 @@ def format_key(key: tuple) -> str:
     elif kind == "pdecode":
         _, cfg, kv, gather, checked = key
         bits = [f"kv_limit={kv}", f"cfg={_format_sampling(cfg)}"]
-    elif kind == "pverify":
+    elif kind in ("pverify", "ptree"):
         _, kv, k, gather, checked = key
         bits = [f"kv_limit={kv}", f"k={k}"]
     elif kind == "pmixed":

@@ -1,11 +1,16 @@
 """Weight-free draft proposers for speculative serving.
 
 The paged engine's verify step (docs/serving.md "Speculative decoding")
-accepts drafts from any :class:`DraftProposer` — the acceptance rule
-(:func:`..inference.speculative.accept_rule`) guarantees greedy output is
-token-identical to plain decoding *whatever* the drafter proposes, so a
-proposer is purely a throughput knob: good drafts multiply tokens/step,
-bad drafts cost one wasted multi-token forward.
+accepts drafts from any :class:`DraftProposer` — the acceptance rules
+(:func:`..inference.speculative.accept_rule` for linear chains,
+:func:`..inference.speculative.tree_accept_rule` for packed trees)
+guarantee the emitted stream is token-identical to plain decoding
+*whatever* the drafter proposes: greedy lanes compare against the
+target's argmax, and sampled lanes (``on_device_sampling`` — the old
+greedy-only guard is gone) compare against the same position-keyed
+draws the sequential decode would have made. A proposer is purely a
+throughput knob: good drafts multiply tokens/step, bad drafts cost one
+wasted multi-token forward.
 
 :class:`NGramDrafter` is prompt-lookup decoding (the n-gram drafter of
 vLLM/transformers "prompt lookup"): match the sequence's own trailing
@@ -15,16 +20,34 @@ prefix caching — repetitive traffic (code, retrieval contexts, templated
 docs) drafts well, free text mostly abstains. A small draft *model* can
 slot in later by implementing the same one-method interface against the
 draft checkpoint (reusing :class:`..inference.speculative`'s machinery).
+
+Tree drafting (``PagedConfig.spec_tree``) rides the optional
+``propose_tree`` extension: a drafter that can rank *several* plausible
+continuations hands the engine a packed candidate tree (node 0 is the
+lane's resident token; returned node ``i`` is packed node ``i + 1``)
+and the ancestor-masked verify forward scores every branch at once —
+the engine then commits the deepest accepted root path.
+:class:`NGramDrafter` branches on its distinct top continuations;
+:class:`TreeDrafter` adapts any chain-only proposer. Static topologies
+(Medusa-style sparse trees, ``inference/medusa.py``) convert via
+``MedusaBuffers.packed_parents``.
 """
 
 from __future__ import annotations
 
-from typing import List, Protocol, Sequence, runtime_checkable
+from typing import List, Optional, Protocol, Sequence, Tuple, runtime_checkable
 
 
 @runtime_checkable
 class DraftProposer(Protocol):
-    """Anything that proposes draft tokens for one lane's history."""
+    """Anything that proposes draft tokens for one lane's history.
+
+    Implementations may additionally offer the **optional**
+    ``propose_tree(history, max_nodes, branches)`` extension (see
+    :meth:`TreeDrafter.propose_tree` for the exact contract) — the engine
+    discovers it with ``getattr``, so chain-only drafters keep working
+    unchanged under ``spec_tree`` via the :class:`TreeDrafter` adapter's
+    single-chain fallback."""
 
     def propose(self, history: Sequence[int], max_tokens: int) -> List[int]:
         """Return up to ``max_tokens`` draft tokens continuing ``history``
@@ -74,3 +97,101 @@ class NGramDrafter:
                 if h[start : start + n] == tail:
                     return h[start + n : start + n + max_tokens]
         return []
+
+    def _continuations(
+        self, h: List[int], max_tokens: int, want: int
+    ) -> List[List[int]]:
+        """Up to ``want`` match-site continuations, best-first: same
+        longest-n-first / latest-site-first order as :meth:`propose` (so
+        entry 0 IS the :meth:`propose` chain), falling through to shorter
+        ``n`` only when longer matches didn't fill the quota. Sites are
+        NOT deduplicated by first token — the trie packing in
+        :meth:`propose_tree` merges shared prefixes, so a same-first-token
+        continuation from an earlier site *deepens* the primary chain
+        (the propose chain truncates to one token at the tail of a
+        repeated run; the next site back carries the longer copy) while a
+        divergent one opens a branch."""
+        conts: List[List[int]] = []
+        for n in range(self.max_n, self.min_n - 1, -1):
+            if len(h) <= n or len(conts) >= want:
+                continue
+            tail = h[-n:]
+            for start in range(len(h) - n - 1, -1, -1):
+                if h[start : start + n] != tail:
+                    continue
+                cont = h[start + n : start + n + max_tokens]
+                if cont:
+                    conts.append(cont)
+                    if len(conts) >= want:
+                        break
+        return conts
+
+    def propose_tree(
+        self, history: Sequence[int], max_nodes: int, branches: int = 2
+    ) -> Tuple[List[int], List[int]]:
+        """Branching prompt lookup: the continuations of up to
+        ``branches`` match sites (latest-first, the :meth:`propose` chain
+        first) packed into a token trie rooted at the resident token.
+        Shared prefixes share nodes, so the primary chain is inserted
+        whole before any alternate spends budget — the tree always
+        contains the linear :meth:`propose` chain as its leftmost path
+        (tree accept can only meet or beat linear accept at equal
+        budget), alternates either extend it or branch off where they
+        diverge, and at ``branches == 1`` the tree IS the linear chain.
+        Returns ``(tokens, parents)`` in packed node space: token ``i``
+        is node ``i + 1``, ``parents[i]`` its parent's packed index
+        (0 = root), parents always preceding children."""
+        if max_nodes < 1 or branches < 1:
+            return [], []
+        h = list(history)
+        conts = self._continuations(h, max_nodes, branches)
+        tokens: List[int] = []
+        parents: List[int] = []
+        children: dict = {}  # (parent packed idx, token) -> packed idx
+        for cont in conts:
+            node = 0  # root
+            for tok in cont:
+                nxt = children.get((node, tok))
+                if nxt is None:
+                    if len(tokens) >= max_nodes:
+                        break
+                    tokens.append(tok)
+                    parents.append(node)
+                    nxt = children[(node, tok)] = len(tokens)
+                node = nxt
+        return tokens, parents
+
+
+class TreeDrafter:
+    """Adapter giving any :class:`DraftProposer` the ``propose_tree``
+    face. Wrapping a drafter that already implements ``propose_tree``
+    (e.g. :class:`NGramDrafter`) delegates with this adapter's default
+    ``branches``; wrapping a chain-only drafter degrades gracefully to a
+    single-chain tree (``parents[i] = i`` — node ``i + 1`` hangs off node
+    ``i``), which the tree accept rule scores bit-for-bit like the linear
+    verify path. Static sparse topologies (Medusa) are a different
+    animal — their node set is fixed per step and filled from draft-head
+    logits, so they plug in as proposers of their own with
+    ``MedusaBuffers.packed_parents`` supplying the parents vector."""
+
+    def __init__(self, inner: DraftProposer, branches: int = 2) -> None:
+        if branches < 1:
+            raise ValueError(f"branches must be >= 1, got {branches}")
+        self.inner = inner
+        self.branches = branches
+
+    def propose(self, history: Sequence[int], max_tokens: int) -> List[int]:
+        return self.inner.propose(history, max_tokens)
+
+    def propose_tree(
+        self,
+        history: Sequence[int],
+        max_nodes: int,
+        branches: Optional[int] = None,
+    ) -> Tuple[List[int], List[int]]:
+        b = self.branches if branches is None else branches
+        inner_tree = getattr(self.inner, "propose_tree", None)
+        if inner_tree is not None:
+            return inner_tree(history, max_nodes, b)
+        chain = list(self.inner.propose(history, max_nodes))
+        return chain, list(range(len(chain)))

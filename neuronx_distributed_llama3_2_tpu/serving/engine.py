@@ -233,8 +233,20 @@ class PagedConfig:
     # speculative decoding (docs/serving.md "Speculative decoding"): draft
     # up to this many tokens per lane per step and verify them in ONE
     # multi-token forward — accepted drafts multiply tokens/step. 0 = off.
-    # Greedy sampling only (acceptance compares the target's argmax).
+    # Greedy host sampling compares the target's argmax; with
+    # on_device_sampling the verify targets are the same position-keyed
+    # draws sequential decoding would make, so sampled lanes speculate too.
     spec_draft_tokens: int = 0
+    # tree speculation (docs/serving.md "Tree speculation"): drafts become
+    # a packed candidate TREE of up to spec_draft_tokens nodes — several
+    # branches share one ancestor-masked verify forward (`ptree`) and the
+    # deepest accepted root path commits, so drafty-but-ambiguous traffic
+    # beats a single chain at the same draft budget. Requires
+    # spec_draft_tokens > 0; drafters without propose_tree degrade to
+    # single-chain trees (token-identical to linear speculation).
+    spec_tree: bool = False
+    # branch fan-out the default prompt-lookup drafter targets per tree
+    spec_tree_branches: int = 2
     # n-gram window of the default prompt-lookup drafter (serving/drafter.py)
     spec_ngram_max: int = 3
     spec_ngram_min: int = 1
@@ -436,6 +448,22 @@ class PagedServingEngine:
         self._spec_k = int(paged.spec_draft_tokens or 0)
         if self._spec_k < 0:
             raise ValueError("spec_draft_tokens must be >= 0")
+        # tree speculation: verify a packed candidate tree (ptree program)
+        # instead of a single chain. Set before the catalog build below —
+        # the manifest swaps its verify rungs to ptree keys under the flag.
+        self._spec_tree = bool(paged.spec_tree)
+        if self._spec_tree and not self._spec_k:
+            raise ValueError(
+                "spec_tree requires spec_draft_tokens > 0 (the tree's node "
+                "budget IS the draft-token budget)"
+            )
+        if self._spec_tree and self._spec_k + 1 > 32:
+            raise ValueError(
+                "spec_tree packs ancestor sets into int32 bitmasks — "
+                f"spec_draft_tokens ({self._spec_k}) must be <= 31"
+            )
+        if paged.spec_tree_branches < 1:
+            raise ValueError("spec_tree_branches must be >= 1")
         # fused on-device sampling (docs/serving.md "On-device sampling"):
         # per-lane params + PRNG key data live device-resident and the
         # decode/verify/prefill programs sample in-fuse
@@ -1228,6 +1256,82 @@ class PagedServingEngine:
             kv_limit=kv_limit, k=k,
         )
 
+    def _tree_program(self, kv_limit: int, k: int):
+        """Tree-speculative verify (``PagedConfig.spec_tree``): score a
+        packed candidate TREE of k draft nodes rooted at the resident
+        token in one ancestor-masked T = k+1 forward, accept the deepest
+        root-anchored path on device and relocate its K/V rows to the
+        true frontier (``LlamaDecode.tree_verify_step``). The whole draft
+        — node tokens, tree topology and per-lane live-node count — rides
+        in as ONE packed (B, 2k+1) int32 upload
+        ``[drafts(k) | parents(k) | live_draft_nodes(1)]``, one fewer
+        metered upload than the linear verify's drafts + draft_len pair,
+        so tree speculation fits the same ≤2-upload verify budget.
+        Donation, checked and fused-sampling variants mirror
+        ``_verify_program`` exactly; a lane whose drafter abstained
+        carries zero live nodes and takes a plain decode step."""
+        checked = self._check_logits
+        key_ = ("ptree", kv_limit, k, self._gather_shed(), checked)
+        if key_ in self._programs:
+            return self._programs[key_]
+        model, engine = self._step_model(), self.engine
+        pos_cap = self._pos_cap
+
+        def unpack(tokens, payload):
+            drafts = payload[:, :k]
+            parents = jnp.concatenate(
+                [jnp.zeros_like(payload[:, :1]), payload[:, k : 2 * k]],
+                axis=1,
+            )
+            node_len = payload[:, 2 * k] + 1  # root is always live
+            block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            return block, parents, node_len
+
+        if self._fused and checked:
+            def fn(params, cache, tokens, positions, tables, payload,
+                   temp, topk, topp, rng, nan_mask):
+                params = engine._live_params(params)
+                block, parents, node_len = unpack(tokens, payload)
+                return model.tree_verify_step(
+                    params, cache, block, positions, tables, parents,
+                    node_len, kv_limit=kv_limit, pos_cap=pos_cap,
+                    sampling=(rng, temp, topk, topp), logit_poison=nan_mask,
+                )
+        elif self._fused:
+            def fn(params, cache, tokens, positions, tables, payload,
+                   temp, topk, topp, rng):
+                params = engine._live_params(params)
+                block, parents, node_len = unpack(tokens, payload)
+                return model.tree_verify_step(
+                    params, cache, block, positions, tables, parents,
+                    node_len, kv_limit=kv_limit, pos_cap=pos_cap,
+                    sampling=(rng, temp, topk, topp),
+                )
+        elif checked:
+            def fn(params, cache, tokens, positions, tables, payload,
+                   nan_mask):
+                params = engine._live_params(params)
+                block, parents, node_len = unpack(tokens, payload)
+                return model.tree_verify_step(
+                    params, cache, block, positions, tables, parents,
+                    node_len, kv_limit=kv_limit, pos_cap=pos_cap,
+                    logit_poison=nan_mask,
+                )
+        else:
+            def fn(params, cache, tokens, positions, tables, payload):
+                params = engine._live_params(params)
+                block, parents, node_len = unpack(tokens, payload)
+                return model.tree_verify_step(
+                    params, cache, block, positions, tables, parents,
+                    node_len, kv_limit=kv_limit, pos_cap=pos_cap,
+                )
+
+        return self._register_program(
+            key_, fn, donate_argnums=(1, 3), kind="ptree",
+            gather=self._gather_shed(), checked=checked,
+            kv_limit=kv_limit, k=k,
+        )
+
     def _mixed_program(self, t: int, kv_limit: int):
         """Fused mixed-mode step (``PagedConfig.fused_step``): ONE t-row
         program serving every lane role at once — decode lanes ride as a
@@ -1239,7 +1343,13 @@ class PagedServingEngine:
         payload (rows/row_start/row_len/forced) uploads like verify's
         drafts — prefill traffic always paid per-call uploads, and the
         pure-decode steady state never dispatches this kind (GC003 holds).
-        Fused-sampling and checked variants mirror ``_verify_program``."""
+        Fused-sampling and checked variants mirror ``_verify_program``.
+
+        Under ``spec_tree`` the verify rows carry a packed tree: a per-lane
+        ``parents`` operand rides immediately after ``forced`` and
+        ``LlamaDecode.mixed_step`` steers forced lanes onto the
+        single-chain topology, so chunk semantics (and the key) are
+        unchanged — the tree flavor is engine-scoped, not a new rung."""
         checked = self._check_logits
         cfg = self._decode_cfg()
         key_ = ("pmixed", t, kv_limit, cfg, self._gather_shed(), checked)
@@ -1247,47 +1357,25 @@ class PagedServingEngine:
             return self._programs[key_]
         model, engine = self._step_model(), self.engine
         pos_cap = self._pos_cap
+        fused, spec_tree = self._fused, self._spec_tree
 
-        if self._fused and checked:
-            def fn(params, cache, tokens, positions, tables, rows,
-                   row_start, row_len, forced, temp, topk, topp, rng,
-                   nan_mask):
-                params = engine._live_params(params)
-                return model.mixed_step(
-                    params, cache, tokens, positions, tables,
-                    rows, row_start, row_len, forced,
-                    kv_limit=kv_limit, pos_cap=pos_cap,
-                    sampling=(rng, temp, topk, topp), logit_poison=nan_mask,
-                )
-        elif self._fused:
-            def fn(params, cache, tokens, positions, tables, rows,
-                   row_start, row_len, forced, temp, topk, topp, rng):
-                params = engine._live_params(params)
-                return model.mixed_step(
-                    params, cache, tokens, positions, tables,
-                    rows, row_start, row_len, forced,
-                    kv_limit=kv_limit, pos_cap=pos_cap,
-                    sampling=(rng, temp, topk, topp),
-                )
-        elif checked:
-            def fn(params, cache, tokens, positions, tables, rows,
-                   row_start, row_len, forced, nan_mask):
-                params = engine._live_params(params)
-                return model.mixed_step(
-                    params, cache, tokens, positions, tables,
-                    rows, row_start, row_len, forced,
-                    kv_limit=kv_limit, pos_cap=pos_cap,
-                    logit_poison=nan_mask,
-                )
-        else:
-            def fn(params, cache, tokens, positions, tables, rows,
-                   row_start, row_len, forced):
-                params = engine._live_params(params)
-                return model.mixed_step(
-                    params, cache, tokens, positions, tables,
-                    rows, row_start, row_len, forced,
-                    kv_limit=kv_limit, pos_cap=pos_cap,
-                )
+        def fn(params, cache, tokens, positions, tables, rows,
+               row_start, row_len, forced, *tail):
+            params = engine._live_params(params)
+            tail = list(tail)
+            kw = dict(kv_limit=kv_limit, pos_cap=pos_cap)
+            if spec_tree:
+                kw["parents"] = tail.pop(0)
+            if fused:
+                temp, topk, topp, rng = tail[:4]
+                tail = tail[4:]
+                kw["sampling"] = (rng, temp, topk, topp)
+            if checked:
+                kw["logit_poison"] = tail.pop(0)
+            return model.mixed_step(
+                params, cache, tokens, positions, tables,
+                rows, row_start, row_len, forced, **kw,
+            )
 
         return self._register_program(
             key_, fn, donate_argnums=(1, 3), kind="pmixed",
@@ -1938,6 +2026,25 @@ class PagedServingEngine:
                     else:
                         _, _, toks, self._d_positions, self.cache = fn(*args)
                     self._d_tokens = toks
+                elif kind == "ptree":
+                    _, kv, k, _g, _c = key_
+                    fn = self._tree_program(kv, k)
+                    # all-zero packed payload: zero live draft nodes per
+                    # lane, so every lane is a plain decode row writing
+                    # into the null block (the chain-degenerate tree)
+                    args = (
+                        eng.params, self.cache, self._d_tokens,
+                        self._d_positions, self._d_tables,
+                        jnp.zeros((eng.max_batch, 2 * k + 1), jnp.int32),
+                        *(d_tail() if self._fused else ()),
+                    )
+                    if self._check_logits:
+                        _, _, toks, self._d_positions, _, self.cache = fn(
+                            *args, self._nan_mask((), "warmup")
+                        )
+                    else:
+                        _, _, toks, self._d_positions, self.cache = fn(*args)
+                    self._d_tokens = toks
                 elif kind == "pmixed":
                     _, t, kv, _cfg, _g, _c = key_
                     fn = self._mixed_program(t, kv)
@@ -1949,6 +2056,10 @@ class PagedServingEngine:
                         self._d_positions, self._d_tables,
                         jnp.zeros((eng.max_batch, t), jnp.int32),
                         zeros_b, zeros_b, zeros_b,
+                        *(
+                            (jnp.zeros((eng.max_batch, t), jnp.int32),)
+                            if self._spec_tree else ()
+                        ),
                         *(d_tail() if self._fused else ()),
                     )
                     if self._check_logits:
@@ -3121,6 +3232,50 @@ class PagedServingEngine:
                 out[lane] = list(drafts[:limit])
         return out
 
+    def _collect_tree_drafts(self) -> Dict[int, tuple]:
+        """Tree-speculation sibling of :meth:`_collect_drafts`: ask the
+        drafter for a packed candidate tree per decode-ready lane —
+        ``lane -> (tokens, parents)`` with token ``i`` = packed node
+        ``i + 1`` and ``parents[i]`` its parent's packed index (0 = the
+        resident root). Drafters without ``propose_tree`` degrade to a
+        single chain from ``propose`` (token-identical to linear
+        speculation); abstention, the node budget (``min(spec_draft_tokens,
+        remaining - 1)`` — tree depth <= node count, so acceptance can
+        never overshoot ``max_new_tokens``) and the advisory failure
+        contract are exactly the linear collector's."""
+        k = self._spec_k
+        branches = self.paged.spec_tree_branches
+        propose_tree = getattr(self.drafter, "propose_tree", None)
+        out: Dict[int, tuple] = {}
+        for lane, req in self._active.items():
+            if req.prefilling or req.spec_disabled:
+                continue
+            remaining = self.gen.max_new_tokens - len(req.out)
+            limit = min(k, remaining - 1)
+            if limit < 1:
+                continue
+            try:
+                if self.injector is not None:
+                    self.injector.drafter_fault()
+                history = req.prompt + req.out
+                if propose_tree is not None:
+                    tokens, parents = propose_tree(history, limit, branches)
+                else:
+                    tokens = list(self.drafter.propose(history, limit))
+                    parents = list(range(len(tokens)))
+            except Exception as exc:
+                self.metrics.drafter_faults += 1
+                self._note_event()
+                logger.warning(
+                    "drafter failed for request %d: %s", req.rid, exc
+                )
+                continue
+            if tokens:
+                # a trailing trim is always topology-safe: packed order
+                # puts every parent before its children
+                out[lane] = (list(tokens[:limit]), list(parents[:limit]))
+        return out
+
     def _prepare_spec_blocks(self, proposals: Dict[int, List[int]]) -> None:
         """Back each drafting lane's verify-write rows (``position ..
         position + draft_len``) with real blocks WITHOUT preempting:
@@ -3157,8 +3312,20 @@ class PagedServingEngine:
         action. Returns ``drafted``: False means nothing was dispatched
         (the drafter abstained everywhere or backing preempted every
         drafting lane) and the policy is expected to schedule a plain
-        decode instead."""
-        proposals = self._collect_drafts()
+        decode instead.
+
+        Under ``spec_tree`` the draft is a packed candidate tree per lane
+        (:meth:`_collect_tree_drafts`) dispatched through the ``ptree``
+        program — the whole tree (tokens + topology + live count) rides
+        one packed upload, and accept lengths are root-path depths."""
+        tree = self._spec_tree
+        tree_parents: Dict[int, List[int]] = {}
+        if tree:
+            collected = self._collect_tree_drafts()
+            proposals = {l: tp[0] for l, tp in collected.items()}
+            tree_parents = {l: tp[1] for l, tp in collected.items()}
+        else:
+            proposals = self._collect_drafts()
         if proposals:
             self._prepare_spec_blocks(proposals)
         if proposals:
@@ -3179,14 +3346,27 @@ class PagedServingEngine:
         self._flush_state()
         eng = self.engine
         k = self._spec_k
-        drafts = np.zeros((eng.max_batch, k), np.int32)
         draft_len = np.zeros((eng.max_batch,), np.int32)
-        for lane, d in proposals.items():
-            drafts[lane, : len(d)] = d
-            draft_len[lane] = len(d)
+        if tree:
+            # one packed (B, 2k+1) payload: [drafts | parents | live nodes]
+            payload = np.zeros((eng.max_batch, 2 * k + 1), np.int32)
+            for lane, d in proposals.items():
+                pars = tree_parents[lane][: len(d)]
+                payload[lane, : len(d)] = d
+                payload[lane, k : k + len(pars)] = pars
+                payload[lane, 2 * k] = len(d)
+                draft_len[lane] = len(d)
+        else:
+            drafts = np.zeros((eng.max_batch, k), np.int32)
+            for lane, d in proposals.items():
+                drafts[lane, : len(d)] = d
+                draft_len[lane] = len(d)
         kv_need = int(max(self._positions[l] for l in decode_lanes)) + k + 1
         kv_limit = self._kv_bucket(kv_need)
-        fn = self._verify_program(kv_limit, k)
+        fn = (
+            self._tree_program(kv_limit, k)
+            if tree else self._verify_program(kv_limit, k)
+        )
         self.metrics.note_decode_dispatch(
             kv_limit, kv_need,
             *(self._flops_by_key.get(fn.key) or (0.0, 0.0)),
@@ -3197,7 +3377,10 @@ class PagedServingEngine:
         args = (
             eng.params, self.cache,
             self._d_tokens, self._d_positions, self._d_tables,
-            self._upload(drafts), self._upload(draft_len),
+        ) + (
+            (self._upload(payload),)
+            if tree
+            else (self._upload(drafts), self._upload(draft_len))
         )
         if self._fused:
             # sampled verify: accept targets become position-keyed draws
@@ -3219,18 +3402,28 @@ class PagedServingEngine:
             tr.complete(
                 "dispatch", t_d, program=program_label(fn), mode="verify",
                 sampling=smode, lanes=len(decode_lanes),
-                drafts=int(draft_len.sum()),
+                drafts=int(draft_len.sum()), tree=tree,
                 kv_bucket=kv_limit, kv_pad=kv_limit - kv_need,
             )
         self._d_tokens = new_tokens
         self._dispatch_count += 1
-        self._emit_action(
-            ActionType.VERIFY, lanes=list(decode_lanes), k=k,
-            drafts=int(draft_len.sum()), kv=kv_limit,
-        )
+        if tree:
+            self._emit_action(
+                ActionType.VERIFY, lanes=list(decode_lanes), k=k,
+                drafts=int(draft_len.sum()), kv=kv_limit,
+                tree=True, nodes=int(draft_len.sum()),
+            )
+        else:
+            self._emit_action(
+                ActionType.VERIFY, lanes=list(decode_lanes), k=k,
+                drafts=int(draft_len.sum()), kv=kv_limit,
+            )
         self.metrics.decode_steps += 1
         self.metrics.verify_steps += 1
         self.metrics.draft_tokens += int(draft_len.sum())
+        if tree:
+            self.metrics.tree_verify_steps += 1
+            self.metrics.tree_draft_tokens += int(draft_len.sum())
         emitted = self._read_tokens(emitted_d)      # (B, k+1)
         accept = self._read_tokens(accept_d)        # (B,)
         fin = None if finite_d is None else self._read_tokens(finite_d)
@@ -3249,6 +3442,8 @@ class PagedServingEngine:
             self.metrics.accepted_tokens += a
             if draft_len[lane]:
                 self.metrics.hist_accept_len.observe(a)
+                if tree:
+                    self.metrics.note_tree_accept(f"t{k + 1}", a)
             req.spec_drafted += int(draft_len[lane])
             req.spec_accepted += a
             self._positions[lane] += a + 1  # mirror the on-device advance
@@ -3299,12 +3494,21 @@ class PagedServingEngine:
         if not any(r.prefilling for r in self._active.values()):
             return False
         t = self._mixed_t
+        tree = self._spec_tree
         proposals: Dict[int, List[int]] = {}
+        tree_parents: Dict[int, List[int]] = {}
         if self._spec_k:
             # mixed rows cap drafts at t - 1 (row 0 is the resident token)
-            proposals = {
-                l: d[: t - 1] for l, d in self._collect_drafts().items()
-            }
+            if tree:
+                collected = self._collect_tree_drafts()
+                proposals = {
+                    l: tp[0][: t - 1] for l, tp in collected.items()
+                }
+                tree_parents = {l: tp[1] for l, tp in collected.items()}
+            else:
+                proposals = {
+                    l: d[: t - 1] for l, d in self._collect_drafts().items()
+                }
             if proposals:
                 self._prepare_spec_blocks(proposals)
         self._ensure_decode_blocks()
@@ -3347,6 +3551,14 @@ class PagedServingEngine:
         for lane, d in proposals.items():
             rows[lane, : len(d)] = d
             row_len[lane] = len(d)
+        if tree:
+            # per-lane packed topology: node j = rows[j-1], parent indices
+            # in node space (0 = the resident root). Forced lanes don't
+            # read theirs — mixed_step steers them onto the chain.
+            parents_arr = np.zeros((eng.max_batch, t), np.int32)
+            for lane, d in proposals.items():
+                pars = tree_parents[lane][: len(d)]
+                parents_arr[lane, 1 : 1 + len(pars)] = pars
         kv_need = max(
             max(start for _, start, _, _ in pieces.values()),
             max(
@@ -3368,6 +3580,8 @@ class PagedServingEngine:
             self._upload(rows), self._upload(row_start),
             self._upload(row_len), self._upload(forced),
         )
+        if tree:
+            args += (self._upload(parents_arr),)
         if self._fused:
             args += (
                 self._d_temps, self._d_topks, self._d_topps, self._d_rng,
@@ -3415,6 +3629,11 @@ class PagedServingEngine:
             self.metrics.draft_tokens += sum(
                 len(d) for d in proposals.values()
             )
+            if tree:
+                self.metrics.tree_verify_steps += 1
+                self.metrics.tree_draft_tokens += sum(
+                    len(d) for d in proposals.values()
+                )
         emitted = self._read_tokens(emitted_d)      # (B, t)
         accept = self._read_tokens(accept_d)        # (B,)
         fin = None if finite_d is None else self._read_tokens(finite_d)
@@ -3467,6 +3686,8 @@ class PagedServingEngine:
             self.metrics.accepted_tokens += a
             if dl:
                 self.metrics.hist_accept_len.observe(a)
+                if tree:
+                    self.metrics.note_tree_accept(f"t{t}", a)
             req.spec_drafted += dl
             req.spec_accepted += a
             self._positions[lane] += a + 1  # mirror the on-device advance

@@ -120,6 +120,15 @@ class ServingMetrics:
     verify_steps: int = 0          # of decode_steps, multi-token verifies
     spec_disabled_lanes: int = 0   # requests dropped to plain decode (low
     #                                accept rate past probation)
+    # -- tree speculation (docs/serving.md "Tree speculation"): packed
+    #    draft trees through the ancestor-masked verify; draft/accepted
+    #    token totals fold into the linear counters above, these track
+    #    the tree-shaped subset and the per-shape accept-depth mix --
+    tree_verify_steps: int = 0     # of verify_steps, packed-tree verifies
+    tree_draft_tokens: int = 0     # of draft_tokens, offered as tree nodes
+    tree_accept_by_shape: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)  # shape (e.g. "t5") -> {lanes, accepted,
+    #                            by_len: {accept_len: lanes}}
     # -- compiled-program catalog (docs/serving.md "Compiled-program
     #    catalog"): every _register_program hit bumps programs_compiled;
     #    compiles during PagedServingEngine.prewarm() count as
@@ -320,6 +329,20 @@ class ServingMetrics:
         """Fraction of offered draft tokens the target accepted."""
         return self.accepted_tokens / self.draft_tokens if self.draft_tokens else 0.0
 
+    def note_tree_accept(self, shape: str, accept: int) -> None:
+        """Fold one lane's tree-verify outcome into the per-shape
+        breakdown: ``shape`` names the packed-tree rung (``"t5"`` = 5
+        packed nodes), ``accept`` is the accepted root-path depth (0 =
+        only the bonus token survived)."""
+        d = self.tree_accept_by_shape.get(shape)
+        if d is None:
+            d = self.tree_accept_by_shape[shape] = {
+                "lanes": 0, "accepted": 0, "by_len": {},
+            }
+        d["lanes"] += 1
+        d["accepted"] += accept
+        d["by_len"][accept] = d["by_len"].get(accept, 0) + 1
+
     def snapshot(
         self,
         allocator: Optional[BlockAllocator] = None,
@@ -350,6 +373,10 @@ class ServingMetrics:
         }
         rec["mfu_by_rung"] = {
             rung: dict(v) for rung, v in sorted(self.mfu_by_rung.items())
+        }
+        rec["tree_accept_by_shape"] = {
+            shape: dict(v, by_len=dict(v["by_len"]))
+            for shape, v in sorted(self.tree_accept_by_shape.items())
         }
         # graftserve per-class tables export as copies too
         rec["requests_by_class"] = {
@@ -481,6 +508,20 @@ class ServingMetrics:
                 lines.append(
                     f'serving_policy_simulated_burn_class{{class="{cls}",'
                     f'objective="{objective}"}} {psb[cls][objective]:g}')
+        # tree speculation per-shape accept mix: lanes labelled by packed
+        # shape and accepted root-path depth (per-rung family precedent)
+        tas = snap.get("tree_accept_by_shape") or {}
+        if tas:
+            lines.append("# TYPE serving_tree_accept_lanes_shape counter")
+        for shape in sorted(tas):
+            v = tas[shape]
+            lines.append(
+                f'serving_tree_accept_tokens_shape{{shape="{shape}"}} '
+                f'{v["accepted"]:g}')
+            for alen in sorted(v["by_len"]):
+                lines.append(
+                    f'serving_tree_accept_lanes_shape{{shape="{shape}",'
+                    f'len="{alen}"}} {v["by_len"][alen]:g}')
         roofs = snap.get("mfu_by_rung") or {}
         if roofs:
             lines.append("# TYPE serving_roofline_mfu_rung gauge")

@@ -280,6 +280,42 @@ def _catalog_spill_engine(prewarm=True):
     )
 
 
+def _catalog_tree_engine(prewarm=True):
+    """``spec_tree`` twin of the catalog-int8 engine: same strict knob
+    set, but the verify rungs of the kv × k ladder compile as packed-tree
+    ("ptree") programs — the ancestor-masked verify forward with the
+    parents/node-length operands — and the linear pverify family leaves
+    the manifest entirely (same key count, different program per rung).
+    The drive below mixes repetitive prompts (so the branching NGram
+    drafter actually proposes trees and the ptree programs dispatch)
+    with random ones, and the recorded VERIFY actions carry the
+    ``tree``/``nodes`` meta that graftsched's GC010 arm bounds-checks."""
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    cfg, params = _tiny()
+    return PagedServingEngine(
+        InferenceEngine(
+            cfg, params, max_batch=4, max_seq_len=64, buckets=[8, 16]
+        ),
+        GenerationConfig(max_new_tokens=6),
+        PagedConfig(
+            block_size=8, num_blocks=32, kv_cache_dtype="int8",
+            quant_mxu=True, on_device_sampling=True,
+            spec_draft_tokens=4, spec_tree=True,
+            prefill_chunk_tokens=6, async_loop=True,
+            trace_enabled=True, trace_buffer_steps=64, prewarm=prewarm,
+        ),
+        precompile=False,
+    )
+
+
 def _catalog_tp2_engine(prewarm=True):
     """tp=2 catalog twin (caller owns the mesh): bf16 pool, chunked
     prefill, single-bucket ladder — small enough that the 9-key manifest
@@ -548,6 +584,51 @@ def entry_catalog_spill():
     )
 
 
+def entry_catalog_tree():
+    """The spec_tree twin: GC001-GC010 over the ptree-bearing registry
+    (GC010's tree-meta arm bounds every recorded tree VERIFY's node
+    count), byte-identity against its own golden entry, and a drive with
+    repetitive traffic that proves the packed-tree verify actually
+    dispatches — trees proposed, one packed upload per verify, zero
+    steady-state compiles, and no linear pverify key anywhere in the
+    manifest."""
+    engine = _catalog_tree_engine()
+    keys = set(engine.catalog.keys())
+    assert not any(k[0] == "pverify" for k in keys), (
+        "spec_tree manifest still declares linear pverify keys"
+    )
+    assert any(k[0] == "ptree" for k in keys), (
+        "spec_tree manifest declares no ptree keys"
+    )
+    cfg, _ = _tiny()
+    rng = np.random.default_rng(7)
+    # period-3 repetition drafts well under prompt lookup (the trie
+    # drafter branches at the run tails); random fillers keep the
+    # admission mix heterogeneous like the other catalog drives
+    motif = rng.integers(0, cfg.vocab_size, size=(3,)).tolist()
+    for n in (3, 5, 7, 13, 20):
+        engine.submit((motif * 7)[:n] if n % 2 else
+                      rng.integers(0, cfg.vocab_size, size=(n,)).tolist())
+    engine.run_to_completion()
+    m = engine.metrics
+    assert m.steadystate_compiles == 0, (
+        "tree catalog engine compiled past the freeze: "
+        f"{m.steadystate_compiles}"
+    )
+    assert m.tree_verify_steps > 0, (
+        "repetitive drive never dispatched a packed-tree verify"
+    )
+    assert m.tree_draft_tokens > 0, (
+        "tree verifies dispatched but no nodes were ever offered"
+    )
+    return (
+        audit_programs(engine)
+        + _sched_trace_findings("catalog-tree", engine)
+        + _catalog_drift("catalog-tree", engine)
+        + _costs_drift("catalog-tree", engine)
+    )
+
+
 def entry_catalog_tp2():
     """Same contract under a pure-tp=2 mesh: the prewarmed 9-key manifest
     must bound the shard_mapped registry exactly."""
@@ -687,6 +768,7 @@ CATALOG = (
     ("catalog-int8", entry_catalog),
     ("catalog-fused", entry_catalog_fused),
     ("catalog-spill", entry_catalog_spill),
+    ("catalog-tree", entry_catalog_tree),
     ("decode", entry_decode),
     ("decode-int8", entry_decode_int8),
     ("decode-int8-mxu", entry_decode_int8_mxu),
@@ -747,6 +829,7 @@ def main(argv=None) -> int:
             "catalog-int8": _catalog_engine(prewarm=False).catalog,
             "catalog-fused": _catalog_fused_engine(prewarm=False).catalog,
             "catalog-spill": _catalog_spill_engine(prewarm=False).catalog,
+            "catalog-tree": _catalog_tree_engine(prewarm=False).catalog,
         }
         initialize_model_parallel(
             tensor_model_parallel_size=2, devices=jax.devices()[:2]
@@ -777,6 +860,9 @@ def main(argv=None) -> int:
             ),
             "catalog-spill": _cost_lines(
                 _catalog_spill_engine(prewarm=False)
+            ),
+            "catalog-tree": _cost_lines(
+                _catalog_tree_engine(prewarm=False)
             ),
         }
         initialize_model_parallel(
@@ -823,6 +909,10 @@ def main(argv=None) -> int:
         )
         drift += _costs_drift(
             "catalog-spill", _catalog_spill_engine(prewarm=False),
+            args.costs_file,
+        )
+        drift += _costs_drift(
+            "catalog-tree", _catalog_tree_engine(prewarm=False),
             args.costs_file,
         )
         initialize_model_parallel(

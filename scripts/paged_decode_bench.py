@@ -48,7 +48,18 @@ Three measurements for the gather-free paged decode path (docs/serving.md):
    records zero host->device uploads (the GC003 twin for sampled
    traffic); the speedup column is meaningful only on a real chip.
 
-7. **Fused mixed-mode A/B** for ``PagedConfig.fused_step``: the same
+7. **Tree-speculation A/B** for ``PagedConfig.spec_tree``: linear chain
+   verify vs packed-tree verify at *equal* draft budget on repetitive
+   small-alphabet traffic (the regime where the branching prompt-lookup
+   drafter has alternates worth scoring).  Gates: tree outputs are
+   token-identical to the linear-spec engine (both transitively match
+   plain greedy via the spec A/B), and tree tokens/step strictly beats
+   linear — the packed tree always contains the linear chain as its
+   leftmost path, so at equal budget it can only meet or beat it; wall
+   time is reported, not gated (the one-forward branch win needs a real
+   chip).
+
+8. **Fused mixed-mode A/B** for ``PagedConfig.fused_step``: the same
    chunked-prefill-against-decode workload with the fused step off (one
    psfx per chunk plus a decode per step) and on (one ``pmixed`` program
    per step), reporting steps/sec and ``dispatches_per_step`` for both.
@@ -426,6 +437,83 @@ def _spec_ab(config, params, args):
         "spec_disabled_lanes": m.spec_disabled_lanes,
         "plain_wall_s": round(wall_plain, 3),
         "spec_wall_s": round(wall_spec, 3),
+    }
+
+
+def _tree_ab(config, params, args):
+    """Tree vs linear speculation A/B at equal draft budget
+    (docs/serving.md "Tree speculation").  The workload is pinned rather
+    than driven by the smoke knobs: small-alphabet period-3 prompts (the
+    repeated-token runs create the ambiguous tails where the trie
+    drafter's alternates pay off — large-alphabet patterns draft
+    perfectly linearly and the tree can only tie) and enough new tokens
+    that the run tails recur.  Both engines see identical prompts and
+    k = ``--spec-draft-tokens`` draft slots; the tree leg just spends
+    them as a packed trie instead of one chain.  tokens/step here is
+    emitted-per-decode-step, deterministic and backend-independent, so
+    the >1.0x gate holds on CPU smoke and chip alike."""
+    import numpy as np
+
+    from neuronx_distributed_llama3_2_tpu.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from neuronx_distributed_llama3_2_tpu.serving import (
+        PagedConfig,
+        PagedServingEngine,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    lengths = (12, 22, 9, 17)[: args.max_batch]
+    prompts = []
+    for n in lengths:
+        pat = rng.integers(1, 9, size=3).tolist()
+        prompts.append((pat * (n // 3 + 1))[:n])
+    max_new = min(24, args.max_seq_len - max(lengths) - 1)
+    gen = GenerationConfig(max_new_tokens=max_new)
+    buckets = [x for x in (8, 16, 32) if x <= args.max_seq_len]
+    num_blocks = 4 * (args.max_seq_len // args.block_size)
+
+    def run(spec_tree):
+        eng = InferenceEngine(
+            config, params,
+            max_batch=args.max_batch, max_seq_len=args.max_seq_len,
+            buckets=buckets,
+        )
+        paged = PagedServingEngine(
+            eng, gen,
+            PagedConfig(
+                block_size=args.block_size, num_blocks=num_blocks,
+                spec_draft_tokens=args.spec_draft_tokens,
+                spec_tree=spec_tree,
+            ),
+        )
+        for p in prompts:
+            paged.submit(p)
+        t0 = time.perf_counter()
+        out = paged.run_to_completion()
+        wall = time.perf_counter() - t0
+        m = paged.metrics
+        toks = sum(len(t) for t in out.values()) - len(prompts)
+        tps = toks / (max(m.decode_steps, 1) * len(prompts))
+        return out, tps, wall, m
+
+    out_lin, tps_lin, wall_lin, _ = run(False)
+    out_tree, tps_tree, wall_tree, m = run(True)
+    shapes = {
+        s: round(v["accepted"] / max(v["lanes"], 1), 3)
+        for s, v in sorted(m.tree_accept_by_shape.items())
+    }
+    return {
+        "tree_parity": out_lin == out_tree,
+        "tree_tokens_per_step": round(tps_tree, 3),
+        "tree_linear_tokens_per_step": round(tps_lin, 3),
+        "tree_vs_linear": round(tps_tree / max(tps_lin, 1e-9), 3),
+        "tree_verify_steps": m.tree_verify_steps,
+        "tree_draft_nodes": m.tree_draft_tokens,
+        "tree_mean_accept_by_shape": shapes,
+        "tree_wall_s": round(wall_tree, 3),
+        "tree_linear_wall_s": round(wall_lin, 3),
     }
 
 
@@ -862,6 +950,7 @@ def run_bench(args: argparse.Namespace) -> dict:
     stall = _stall_ab(config, params, args)
     loop_ab = _async_ab(config, params, args)
     spec = _spec_ab(config, params, args)
+    tree = _tree_ab(config, params, args)
     tp_ab = _tp_ab(config, params, args)
     quant = _quant_ab(config, params, args)
     samp = _sampling_ab(config, params, args)
@@ -879,6 +968,7 @@ def run_bench(args: argparse.Namespace) -> dict:
         **stall,
         **loop_ab,
         **spec,
+        **tree,
         **tp_ab,
         **quant,
         **samp,
@@ -900,6 +990,18 @@ def run_bench(args: argparse.Namespace) -> dict:
         failures.append(
             "speculation failed to beat 1 token/step on repetitive prompts "
             f"({spec['spec_tokens_per_step']})"
+        )
+    if not tree["tree_parity"]:
+        failures.append(
+            "tree-speculation outputs diverge from the linear-spec engine"
+        )
+    if tree["tree_verify_steps"] < 1:
+        failures.append("tree leg dispatched no packed-tree verify")
+    if tree["tree_vs_linear"] <= 1.0:
+        failures.append(
+            "packed-tree speculation failed to beat linear tokens/step at "
+            f"equal draft budget ({tree['tree_tokens_per_step']} vs "
+            f"{tree['tree_linear_tokens_per_step']} linear)"
         )
     if "tp_ab_skipped" not in tp_ab:
         if not tp_ab["tp_parity"]:

@@ -105,6 +105,32 @@ def render_snapshot(snap: dict) -> str:
     if accept and accept.get("count"):
         lines.insert(lines.index(_hist_row("queue", g("queue_depth", {}))),
                      _hist_row("accept", accept))
+    # speculation panel (docs/serving.md "Tree speculation"): the
+    # packed-tree verify counters plus the per-shape accept-depth mix;
+    # only rendered when tree verifies ran, so spec-off and linear-spec
+    # snapshots draw unchanged
+    tas = g("tree_accept_by_shape") or {}
+    if g("tree_verify_steps") or tas:
+        anchor = lines.index("latency (ms)")
+        lines.insert(anchor, (
+            f"tree spec  verifies {g('tree_verify_steps', 0)}  "
+            f"nodes {g('tree_draft_tokens', 0)}"
+        ))
+        for shape in sorted(tas):
+            v = tas[shape]
+            anchor += 1
+            lanes = int(v.get("lanes", 0) or 0)
+            mean = (v.get("accepted", 0) / lanes) if lanes else 0.0
+            mix = "  ".join(
+                f"{d}:{c}" for d, c in sorted(
+                    (v.get("by_len") or {}).items(),
+                    key=lambda kv: int(kv[0]),
+                )
+            )
+            lines.insert(anchor, (
+                f"  {shape:<9} lanes {lanes}  "
+                f"mean_accept {mean:.2f}  depth {mix}"
+            ))
     # fused mixed-mode step panel (docs/serving.md "Fused mixed-mode
     # step"): dispatches per engine step — the figure fused_step exists
     # to drive toward 1.0 — plus how many dispatches were pmixed. Only
@@ -275,6 +301,17 @@ def parse_prometheus(text: str) -> dict:
                 flat.setdefault("policy_simulated_burn", {}) \
                     .setdefault(labels["class"], {})[labels["objective"]] = \
                     float(val)
+            elif name == "serving_tree_accept_lanes_shape":
+                d = flat.setdefault("tree_accept_by_shape", {}) \
+                    .setdefault(labels["shape"],
+                                {"lanes": 0, "accepted": 0, "by_len": {}})
+                d["by_len"][int(labels["len"])] = _num(val)
+                d["lanes"] = sum(d["by_len"].values())
+            elif name == "serving_tree_accept_tokens_shape":
+                d = flat.setdefault("tree_accept_by_shape", {}) \
+                    .setdefault(labels["shape"],
+                                {"lanes": 0, "accepted": 0, "by_len": {}})
+                d["accepted"] = _num(val)
             elif name == "serving_roofline_mfu_rung":
                 flat.setdefault("mfu_by_rung", {}) \
                     .setdefault(int(labels["rung"]), {})["roofline_mfu"] = \
@@ -377,6 +414,9 @@ def _demo() -> int:
             # fused mixed-mode demo coverage: the dispatch panel row
             # shows a nonzero pmixed count
             fused_step=True, prefill_chunk_tokens=4,
+            # tree-speculation demo coverage: packed-tree drafts on the
+            # repetitive prompts below light up the speculation panel
+            spec_draft_tokens=3, spec_tree=True,
             # tiered-KV demo coverage: the host-tier panel renders (the
             # small demo workload never evicts, so the gauges stay 0)
             spill_enabled=True, host_tier_bytes=64 << 20,
@@ -425,8 +465,15 @@ def _demo() -> int:
     paged.load_policy_table(demo_table, strict=False)
     rng = __import__("numpy").random.default_rng(0)
     for i, n in enumerate((5, 11, 7, 19)):
+        # alternate repetitive prompts (the prompt-lookup drafter
+        # proposes, so the speculation panel renders) with random ones
+        if i % 2:
+            pat = rng.integers(1, 9, size=3).tolist()
+            prompt = (pat * (n // 3 + 1))[:n]
+        else:
+            prompt = rng.integers(1, cfg.vocab_size, size=n).tolist()
         paged.submit(
-            rng.integers(1, cfg.vocab_size, size=n).tolist(),
+            prompt,
             # mixed classes/tenants: the per-class panels render in the
             # demo (burns stay 0.0 under the loose targets)
             service_class="interactive" if i % 2 else "batch",

@@ -224,6 +224,112 @@ def _quant_paged_case(
     return ok
 
 
+def _tree_paged_case(
+    name, b, n, nkv, d, nb, bs, w, kv_limit, num_splits, seed, t,
+    kv_dtype=None, quant_mxu=False,
+):
+    """Packed-tree verify (docs/serving.md "Tree speculation"): the
+    ancestor-masked kernel vs the dense block-table gather oracle.
+
+    Each lane carries its own random packed topology; the kernel gets the
+    per-lane int32 ancestor bitmasks (``tree_bits``), the oracle masks
+    row-by-row from the same ancestor sets: query node ``ti`` sees
+    committed history (``< position``) plus exactly its root path among
+    the packed rows. ``kv_dtype`` adds the quantized-pool variant
+    (in-kernel dequant, optional ``quant_mxu`` int8/fp8 q·k dot) in the
+    same 5e-2 band as the linear quant cases.
+    """
+    from neuronx_distributed_llama3_2_tpu.inference.speculative import (
+        tree_topology,
+    )
+    from neuronx_distributed_llama3_2_tpu.kernels.paged_attention_pallas import (
+        paged_flash_decode,
+    )
+
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = (jax.random.normal(ks[0], (b, t, n, d), jnp.float32) * 0.5).astype(jnp.bfloat16)
+    kf = jax.random.normal(ks[1], (nb, bs, nkv, d), jnp.float32) * 0.5
+    vf = jax.random.normal(ks[2], (nb, bs, nkv, d), jnp.float32) * 0.5
+    quant_kw = {}
+    if kv_dtype is None:
+        kp, vp = kf.astype(jnp.bfloat16), vf.astype(jnp.bfloat16)
+    else:
+        from neuronx_distributed_llama3_2_tpu.quantization import (
+            kv_cache_jax_dtype,
+            kv_dequantize,
+            kv_quantize,
+        )
+
+        qdtype = kv_cache_jax_dtype(kv_dtype)
+        kp, ksc = kv_quantize(kf, qdtype)
+        vp, vsc = kv_quantize(vf, qdtype)
+        quant_kw = dict(k_scale=ksc, v_scale=vsc, quant_mxu=quant_mxu)
+    rng = np.random.default_rng(seed)
+    nblk = -(-kv_limit // bs)
+    perm = rng.permutation(np.arange(1, nb))
+    tables = np.zeros((b, w), np.int32)
+    for i in range(b):
+        tables[i, :nblk] = perm[i * nblk:(i + 1) * nblk]
+    tables = jnp.asarray(tables)
+    positions = jnp.asarray(
+        rng.integers(0, kv_limit - t + 1, size=(b,)), jnp.int32
+    ).at[0].set(kv_limit - t)
+    # per-lane random packed topology (parents[j] < j); lane 0 pinned to
+    # a chain so the block-causal special case is always covered
+    parents = np.zeros((b, t), np.int32)
+    for j in range(1, t):
+        parents[:, j] = rng.integers(0, j, size=b)
+    parents[0] = np.maximum(np.arange(t) - 1, 0)
+    anc = np.asarray(tree_topology(parents)[1])          # (b, t, t) bool
+    tree_bits = jnp.asarray(
+        (anc.astype(np.int64) << np.arange(t)[None, None, :]).sum(-1)
+        .astype(np.int32)
+    )
+
+    def ref(q, kp, vp):
+        if kv_dtype is not None:
+            kp = kv_dequantize(kp, quant_kw["k_scale"], jnp.bfloat16)
+            vp = kv_dequantize(vp, quant_kw["v_scale"], jnp.bfloat16)
+        g = n // nkv
+        jlog = jnp.arange(kv_limit)
+        phys = tables[:, jlog // bs] * bs + (jlog % bs)
+        kg = kp.reshape(nb * bs, nkv, d)[phys]
+        vg = vp.reshape(nb * bs, nkv, d)[phys]
+        qg = q.reshape(b, t, nkv, g, d).astype(jnp.float32)
+        logits = jnp.einsum("bthgd,blhd->bthgl", qg, kg.astype(jnp.float32))
+        logits = logits / jnp.sqrt(jnp.float32(d))
+        # committed history, plus the query node's ancestor set among the
+        # packed rows position..position+t-1
+        u = jlog[None, None, :] - positions[:, None, None]   # (b, 1, L)
+        hist = u < 0
+        vis = (u >= 0) & (u < t) & jnp.take_along_axis(
+            jnp.asarray(anc), jnp.clip(u, 0, t - 1).repeat(t, axis=1),
+            axis=2,
+        )
+        mask = (hist | vis)[:, :, None, None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bthgl,blhd->bthgd", p, vg.astype(jnp.float32))
+        return o.reshape(b, t, n, d)
+
+    o_k = jax.jit(
+        lambda q, kp, vp: paged_flash_decode(
+            q, kp, vp, tables, positions,
+            kv_limit=kv_limit, num_splits=num_splits,
+            tree_bits=tree_bits, **quant_kw,
+        )
+    )(q, kp, vp)
+    o_r = jax.jit(ref)(q, kp, vp)
+    o_k = np.asarray(o_k, np.float32)
+    o_r = np.asarray(o_r, np.float32)
+    denom = max(float(np.abs(o_r).max()), 1e-9)
+    rel = float(np.abs(o_k - o_r).max()) / denom
+    tol = 3e-2 if kv_dtype is None else 5e-2
+    ok = rel < tol
+    print(f"[{'ok' if ok else 'FAIL'}] {name}: rel_fwd={rel:.2e}")
+    return ok
+
+
 def _sampled_decode_case(name, b, v, t, seed):
     """Fused on-device sampling parity: jitted ``sample_lanes`` over
     (B, V) decode (t=1) or (B, T, V) verify logits vs the host
@@ -416,6 +522,20 @@ def main() -> int:
         ok &= _quant_paged_case(
             *c[:11], t=c[11], kv_dtype=c[12], quant_mxu=True
         )
+    # packed-tree verify (PagedConfig.spec_tree): ancestor-bitmask mask
+    # operand vs the dense-gather oracle, per-lane random topologies,
+    # fp + quantized pool + the int8 MXU dot
+    #           name               b  n  nkv d   nb  bs  w  L    spl sd  t
+    tree_cases = [
+        ("tree-verify-t4",        3, 8, 2, 64, 33, 16, 8, 100, 2, 60, 4),
+        ("tree-verify-t8",        2, 4, 4, 64, 17, 16, 4, 64,  1, 61, 8),
+        ("tree-verify-int8-t4",   3, 8, 2, 64, 33, 16, 8, 100, 2, 62, 4,
+         "int8", False),
+        ("tree-verify-mxu-int8-t8", 2, 4, 4, 64, 17, 16, 4, 64, 1, 63, 8,
+         "int8", True),
+    ]
+    for c in tree_cases:
+        ok &= _tree_paged_case(*c)
     # fused on-device sampling (PagedConfig.on_device_sampling): exact
     # host-draw parity for decode- and verify-shaped logits
     sampled_cases = [

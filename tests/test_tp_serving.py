@@ -66,7 +66,10 @@ def _tp_mesh(tp=2):
 
 def test_kernel_gate_tp_divisible_mesh():
     """tp=2 pure mesh with divisible heads: kernel eligible for the whole
-    linear-t range, tree verify still falls back."""
+    linear-t range AND for packed-tree verify — the per-lane ancestor
+    bitmasks ride into the shard_map region replicated like the block
+    tables, so trees cost no new collectives; only a >32-node tree
+    (ancestor sets no longer pack into int32) falls back to the gather."""
     from neuronx_distributed_llama3_2_tpu.inference.model import LlamaDecode
 
     _tp_mesh()
@@ -74,7 +77,12 @@ def test_kernel_gate_tp_divisible_mesh():
     assert m._paged_kernel_eligible(1, None)
     assert m._paged_kernel_eligible(TINY.paged_kernel_max_t, None)
     assert not m._paged_kernel_eligible(TINY.paged_kernel_max_t + 1, None)
-    assert not m._paged_kernel_eligible(1, object())  # tree verify: gather
+    assert m._paged_kernel_eligible(TINY.paged_kernel_max_t, object())
+    wide = LlamaDecode(
+        dataclasses.replace(TINY_KERNEL, paged_kernel_max_t=64)
+    )
+    assert wide._paged_kernel_eligible(33, None)
+    assert not wide._paged_kernel_eligible(33, object())  # int32 bound
 
 
 def test_kernel_gate_indivisible_heads_fall_back():

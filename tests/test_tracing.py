@@ -225,6 +225,8 @@ EXPECTED_SNAPSHOT_KEYS = {
     "host_schedule_ms", "device_wait_ms", "tp_size", "kv_dtype",
     "pool_bytes_per_rank", "pool_bytes_total", "draft_tokens",
     "accepted_tokens", "verify_steps", "spec_disabled_lanes",
+    # tree speculation (PagedConfig.spec_tree)
+    "tree_verify_steps", "tree_draft_tokens", "tree_accept_by_shape",
     "faults_injected", "failed_requests", "lane_quarantines",
     "drafter_faults", "degradation_level", "degradations",
     "audit_violations", "programs_compiled", "prewarm_compiles",
